@@ -1,0 +1,149 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Capability-equivalent to the reference Ray (see SURVEY.md) but designed
+TPU-first: tasks/actors/objects over a C++ shared-memory data plane, gang
+scheduling for ICI-contiguous TPU slices, and ML libraries (train/tune/
+data/serve/rllib) whose compute path is JAX/XLA/Pallas over device meshes.
+
+Public surface mirrors the reference's (python/ray/__init__.py):
+    init/shutdown/is_initialized, remote, get/put/wait, kill/cancel,
+    get_actor, cluster_resources/available_resources/nodes, ObjectRef.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.worker import global_worker, require_connected
+from ray_tpu.remote_function import remote_decorator as remote
+from ray_tpu.actor import ActorHandle, get_actor
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "ObjectRef", "ActorHandle",
+    "cluster_resources", "available_resources", "nodes", "exceptions",
+    "get_runtime_context", "method", "__version__",
+]
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         local_mode: bool = False,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "default",
+         ignore_reinit_error: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None,
+         **kwargs) -> Dict[str, Any]:
+    """Connect this process to a cluster, starting one if needed.
+
+    - ``local_mode=True``: in-process thread execution (unit tests, single-
+      process ML runs) — reference local-mode semantics.
+    - ``address=None``: boot a head (GCS + node daemon + shm store) on this
+      machine and connect as the driver.
+    - ``address="host:port"``: connect to an existing head.
+    """
+    if global_worker.connected:
+        if ignore_reinit_error:
+            return {"address": "existing"}
+        raise RuntimeError("ray_tpu.init() called twice "
+                           "(pass ignore_reinit_error=True to tolerate)")
+    if _system_config:
+        from ray_tpu.core.config import GlobalConfig
+        GlobalConfig.apply(_system_config)
+    if local_mode:
+        merged = dict(resources or {})
+        if num_tpus is not None:
+            merged["TPU"] = float(num_tpus)
+        global_worker.connect_local(num_cpus=num_cpus, resources=merged)
+        return {"address": "local"}
+
+    from ray_tpu.runtime.cluster_backend import connect_or_start
+    info = connect_or_start(
+        global_worker, address=address, num_cpus=num_cpus, num_tpus=num_tpus,
+        resources=resources, object_store_memory=object_store_memory,
+        namespace=namespace)
+    return info
+
+
+def shutdown() -> None:
+    if global_worker.connected:
+        global_worker.disconnect()
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    return require_connected().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return require_connected().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None,
+         fetch_local: bool = True):
+    return require_connected().wait(refs, num_returns=num_returns,
+                                    timeout=timeout, fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    require_connected().kill_actor(actor.actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    require_connected().cancel_task(ref, force=force, recursive=recursive)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return require_connected().backend.cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return require_connected().backend.available_resources()
+
+
+def nodes() -> list:
+    return require_connected().backend.nodes()
+
+
+def method(**opts):
+    """Decorator carrying per-method defaults (e.g. num_returns) on actors."""
+    def wrap(fn):
+        fn.__rtpu_method_options__ = opts
+        return fn
+    return wrap
+
+
+class _RuntimeContext:
+    @property
+    def job_id(self):
+        return global_worker.job_id
+
+    @property
+    def node_id(self):
+        return global_worker.node_id
+
+    @property
+    def worker_id(self):
+        return global_worker.worker_id
+
+    @property
+    def task_id(self):
+        return global_worker.current_task_id
+
+    def get(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id.hex(),
+            "worker_id": self.worker_id.hex(),
+        }
+
+
+def get_runtime_context() -> _RuntimeContext:
+    return _RuntimeContext()

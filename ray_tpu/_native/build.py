@@ -1,0 +1,66 @@
+"""Build the native C++ runtime library (libray_tpu_native.so).
+
+Invoked lazily on first import of ray_tpu.core._native (and by `make native`).
+Rebuilds when any source is newer than the built .so.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(_THIS_DIR, "src")
+LIB_PATH = os.path.join(_THIS_DIR, "libray_tpu_native.so")
+
+SOURCES = [
+    "shm_store.cc",
+    "scheduler.cc",
+]
+
+CXXFLAGS = [
+    "-O2",
+    "-g",
+    "-std=c++17",
+    "-fPIC",
+    "-shared",
+    "-Wall",
+    "-pthread",
+]
+
+
+def needs_build() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(SRC_DIR, s)) > lib_mtime for s in SOURCES
+    )
+
+
+def build(verbose: bool = False) -> str:
+    if not needs_build():
+        return LIB_PATH
+    base_cmd = ["g++"] + CXXFLAGS + [os.path.join(SRC_DIR, s) for s in SOURCES]
+    if verbose:
+        print(" ".join(base_cmd + ["-o", LIB_PATH, "-lrt"]), file=sys.stderr)
+    # Serialize concurrent builds (several workers may import simultaneously).
+    lockfile = LIB_PATH + ".lock"
+    import fcntl
+
+    with open(lockfile, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if needs_build():
+                tmp = LIB_PATH + f".tmp.{os.getpid()}"
+                subprocess.run(base_cmd + ["-o", tmp, "-lrt"], check=True)
+                os.replace(tmp, LIB_PATH)
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+    return LIB_PATH
+
+
+if __name__ == "__main__":
+    build(verbose=True)
+    print(LIB_PATH)

@@ -1,0 +1,111 @@
+"""Central config table, env-var overridable.
+
+Mirrors the reference's single-macro-table design (reference:
+src/ray/common/ray_config_def.h:18,22 — `RAY_CONFIG(type, name, default)`,
+overridable via `RAY_<name>` env vars). Here every entry is declared once in
+`_CONFIG_DEFS` and can be overridden with `RTPU_<name>` in the environment.
+The same table is serialized and passed to every spawned daemon/worker so the
+whole cluster sees one consistent config (reference: services.py system-config
+propagation).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_ENV_PREFIX = "RTPU_"
+
+# name -> (type, default, help)
+_CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
+    # --- object store ---
+    "object_store_memory_bytes": (int, 2 * 1024**3, "per-node shm arena size"),
+    "object_store_max_objects": (int, 1 << 17, "object table slots in the arena"),
+    "memory_store_threshold_bytes": (int, 100 * 1024, "objects <= this inline in the owner memory store; larger go to shm"),
+    "object_transfer_chunk_bytes": (int, 5 * 1024**2, "chunk size for node-to-node object push"),
+    "object_pull_retry_ms": (int, 200, "pull retry interval"),
+    # --- rpc ---
+    "rpc_connect_timeout_s": (float, 10.0, "client connect timeout"),
+    "rpc_call_timeout_s": (float, 60.0, "default unary call deadline"),
+    "rpc_retry_max_attempts": (int, 5, "retryable client attempts"),
+    "rpc_retry_base_ms": (int, 100, "exponential backoff base"),
+    # chaos injection: "Service.Method=N" comma list — fail the first N calls
+    # (reference: src/ray/rpc/rpc_chaos.h:23, RAY_testing_rpc_failure)
+    "testing_rpc_failure": (str, "", "inject rpc failures: Method=N[,Method=N]"),
+    "testing_rpc_delay_ms": (int, 0, "inject fixed delay into every rpc"),
+    # --- scheduling ---
+    "lease_timeout_s": (float, 30.0, "worker lease validity"),
+    "worker_pool_prestart": (int, 0, "workers prestarted per node"),
+    "worker_pool_max": (int, 64, "max workers per node"),
+    "worker_idle_timeout_s": (float, 300.0, "idle worker reap time"),
+    "scheduler_spread_threshold": (float, 0.5, "hybrid policy: utilization above which we spread instead of pack"),
+    "scheduler_top_k_fraction": (float, 0.2, "hybrid policy: random choice among best k nodes"),
+    # --- health / fault tolerance ---
+    "health_check_period_ms": (int, 1000, "GCS -> node ping period"),
+    "health_check_timeout_ms": (int, 5000, "missed-deadline before node marked dead"),
+    "task_max_retries_default": (int, 3, "default retries for normal tasks"),
+    "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    "max_lineage_bytes": (int, 64 * 1024**2, "lineage cache cap per owner"),
+    # --- train / ml ---
+    "train_health_poll_s": (float, 2.0, "train controller worker poll"),
+    # --- misc ---
+    "session_dir": (str, "/tmp/ray_tpu", "root for session artifacts"),
+    "log_to_driver": (bool, True, "forward worker logs to driver"),
+    "event_buffer_size": (int, 10000, "task event buffer cap"),
+    "metrics_export_period_s": (float, 5.0, "metrics push period"),
+}
+
+
+class _Config:
+    """Attribute access over the config table with env overrides applied once."""
+
+    def __init__(self, overrides: dict[str, Any] | None = None):
+        self._values: dict[str, Any] = {}
+        for name, (typ, default, _help) in _CONFIG_DEFS.items():
+            value = default
+            env = os.environ.get(_ENV_PREFIX + name)
+            if env is not None:
+                value = _parse(typ, env)
+            self._values[name] = value
+        if overrides:
+            self.apply(overrides)
+
+    def apply(self, overrides: dict[str, Any]) -> None:
+        for name, value in overrides.items():
+            if name not in _CONFIG_DEFS:
+                raise ValueError(f"unknown config {name!r}")
+            typ = _CONFIG_DEFS[name][0]
+            self._values[name] = _parse(typ, value) if isinstance(value, str) else typ(value)
+
+    def __getattr__(self, name: str):
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def to_json(self) -> str:
+        return json.dumps(self._values)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "_Config":
+        cfg = cls()
+        cfg.apply(json.loads(payload))
+        return cfg
+
+
+def _parse(typ: type, raw: Any) -> Any:
+    if typ is bool:
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+GlobalConfig = _Config()
+
+
+def reload_from_env() -> None:
+    """Re-read env overrides (used by spawned workers after env setup)."""
+    global GlobalConfig
+    GlobalConfig = _Config()

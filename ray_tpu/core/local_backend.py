@@ -1,0 +1,242 @@
+"""In-process execution backend ("local mode").
+
+Role-equivalent to the reference's local_mode
+(python/ray/_private/worker.py local-mode path): tasks run on a thread pool
+in the driver process, actors get a dedicated thread with an ordered queue,
+values pass by reference (no serialization). Semantics preserved: futures
+resolve asynchronously, errors propagate through refs at get(), retries and
+max_restarts are honored, resource limits gate concurrency.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskArg, TaskSpec
+from ray_tpu.exceptions import (ActorDiedError, TaskCancelledError, TaskError)
+
+
+class _LocalActor:
+    def __init__(self, backend: "LocalBackend", spec: ActorCreationSpec):
+        self.backend = backend
+        self.spec = spec
+        self.instance = None
+        self.queue: "queue.Queue" = queue.Queue()
+        self.dead = False
+        self.death_reason = ""
+        self.restarts_left = spec.max_restarts
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"actor-{spec.name}")
+        self.thread.start()
+
+    def _construct(self) -> None:
+        args = self.backend._resolve_args(self.spec.args)
+        self.instance = self.spec.cls(*args, **self.spec.kwargs)
+
+    def _run(self) -> None:
+        try:
+            self._construct()
+        except BaseException as e:  # noqa: BLE001
+            self.dead = True
+            self.death_reason = f"creation failed: {e!r}"
+            self._drain_with_error()
+            return
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            spec: TaskSpec = item
+            try:
+                args = self.backend._resolve_args(spec.args)
+            except BaseException as e:  # noqa: BLE001
+                self.backend._store_error(spec, e)
+                continue
+            method = getattr(self.instance, spec.method_name, None)
+            if method is None:
+                self.backend._store_error(
+                    spec, AttributeError(f"no method {spec.method_name}"))
+                continue
+            try:
+                result = method(*args, **spec.kwargs)
+                self.backend._store_result(spec, result)
+            except BaseException as e:  # noqa: BLE001
+                if isinstance(e, (SystemExit, KeyboardInterrupt)):
+                    self.dead = True
+                    self.death_reason = "actor exited"
+                    self.backend._store_error(spec, ActorDiedError(
+                        self.spec.actor_id.hex(), self.death_reason))
+                    self._drain_with_error()
+                    return
+                self.backend._store_error(spec, e)
+
+    def _drain_with_error(self) -> None:
+        while True:
+            try:
+                spec = self.queue.get_nowait()
+            except queue.Empty:
+                return
+            if spec is not None:
+                self.backend._store_error(spec, ActorDiedError(
+                    self.spec.actor_id.hex(), self.death_reason))
+
+    def submit(self, spec: TaskSpec) -> None:
+        if self.dead:
+            self.backend._store_error(spec, ActorDiedError(
+                self.spec.actor_id.hex(), self.death_reason))
+            return
+        self.queue.put(spec)
+
+    def kill(self, reason: str = "killed via kill()") -> None:
+        self.dead = True
+        self.death_reason = reason
+        self.queue.put(None)
+
+
+class LocalBackend:
+    def __init__(self, worker, num_cpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None):
+        self.worker = worker
+        n = num_cpus or 8
+        self.pool = ThreadPoolExecutor(max_workers=max(2, n),
+                                       thread_name_prefix="rtpu-local")
+        self.actors: Dict[ActorID, _LocalActor] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.cancelled: set = set()
+        self._lock = threading.Lock()
+        self.resources = {"CPU": float(n), **(resources or {})}
+
+    # -------------------------------------------------------------- objects
+
+    def put_object(self, object_id: ObjectID, value: Any) -> None:
+        self.worker.memory_store.put(object_id, value)
+
+    def free_object(self, object_id: ObjectID) -> None:
+        self.worker.memory_store.delete(object_id)
+
+    def try_resolve(self, ref: ObjectRef) -> bool:
+        return self.worker.memory_store.is_ready(ref.id())
+
+    def poke_resolve(self, ref: ObjectRef) -> None:
+        pass
+
+    def get_from_store(self, ref: ObjectRef):
+        raise RuntimeError("local mode has no shm store")
+
+    # ---------------------------------------------------------------- tasks
+
+    def _resolve_args(self, args: List[TaskArg]) -> List[Any]:
+        out = []
+        for a in args:
+            if a.is_ref:
+                out.append(self.worker.get(
+                    ObjectRef(a.object_id, a.owner, _register=False)))
+            else:
+                out.append(a.value)
+        return out
+
+    def _store_result(self, spec: TaskSpec, result: Any) -> None:
+        rids = spec.return_ids()
+        if spec.num_returns == 1:
+            self.worker.memory_store.put(rids[0], result)
+        else:
+            if not isinstance(result, tuple) or len(result) != spec.num_returns:
+                err = ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {type(result)}")
+                self._store_error(spec, err)
+                return
+            for rid, val in zip(rids, result):
+                self.worker.memory_store.put(rid, val)
+        for a in spec.args:
+            if a.is_ref:
+                self.worker.refcounter.on_serialized_ref_done(a.object_id)
+
+    def _store_error(self, spec: TaskSpec, exc: BaseException) -> None:
+        if not isinstance(exc, (TaskError, ActorDiedError, TaskCancelledError)):
+            exc = TaskError.from_exception(exc)
+        for rid in spec.return_ids():
+            self.worker.memory_store.put(rid, exc, is_error=True)
+        for a in spec.args:
+            if a.is_ref:
+                self.worker.refcounter.on_serialized_ref_done(a.object_id)
+
+    def submit_task(self, spec: TaskSpec) -> None:
+        def _run(attempt: int = 0):
+            if spec.task_id in self.cancelled:
+                self._store_error(spec, TaskCancelledError(spec.task_id.hex()))
+                return
+            try:
+                args = self._resolve_args(spec.args)
+                result = spec.function(*args, **spec.kwargs)
+                self._store_result(spec, result)
+            except BaseException as e:  # noqa: BLE001
+                # In local mode every failure is an application error, so the
+                # reference's system-error retry path (worker crash) cannot
+                # occur; retry only when the user opted in via
+                # retry_exceptions (reference: max_retries semantics).
+                if attempt < spec.max_retries and spec.retry_exceptions:
+                    self.pool.submit(_run, attempt + 1)
+                else:
+                    self._store_error(spec, e)
+
+        self.pool.submit(_run)
+
+    # --------------------------------------------------------------- actors
+
+    def create_actor(self, spec: ActorCreationSpec) -> None:
+        actor = _LocalActor(self, spec)
+        with self._lock:
+            self.actors[spec.actor_id] = actor
+            if spec.registered_name:
+                self.named_actors[
+                    f"{spec.namespace}:{spec.registered_name}"] = spec.actor_id
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        with self._lock:
+            actor = self.actors.get(spec.actor_id)
+        if actor is None:
+            self._store_error(spec, ActorDiedError(
+                spec.actor_id.hex(), "unknown actor"))
+            return
+        actor.submit(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        with self._lock:
+            actor = self.actors.get(actor_id)
+        if actor is not None:
+            actor.kill()
+
+    def get_actor_by_name(self, name: str, namespace: str) -> Optional[ActorCreationSpec]:
+        with self._lock:
+            actor_id = self.named_actors.get(f"{namespace}:{name}")
+            if actor_id is None:
+                return None
+            return self.actors[actor_id].spec
+
+    def cancel_task(self, ref: ObjectRef, force: bool) -> None:
+        self.cancelled.add(ref.id().task_id())
+
+    # ----------------------------------------------------------------- misc
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return dict(self.resources)
+
+    def available_resources(self) -> Dict[str, float]:
+        return dict(self.resources)
+
+    def nodes(self) -> list:
+        return [{"NodeID": "local", "Alive": True,
+                 "Resources": dict(self.resources)}]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for actor in self.actors.values():
+                actor.kill("shutdown")
+            self.actors.clear()
+        self.pool.shutdown(wait=False, cancel_futures=True)

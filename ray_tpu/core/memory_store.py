@@ -1,0 +1,102 @@
+"""In-process memory store for small objects and pending futures.
+
+Role-equivalent to the reference's CoreWorkerMemoryStore (reference:
+src/ray/core_worker/store_provider/memory_store/memory_store.h:43): task
+returns below the inline threshold live here in the owner process; larger
+values are promoted to the node's shared-memory store. Get/Wait block on
+per-object events; async waiters register callbacks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+
+
+class _Entry:
+    __slots__ = ("event", "value", "is_error", "in_shm")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value: Any = None
+        self.is_error = False
+        self.in_shm = False  # value lives in the shm store, not here
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._callbacks: Dict[ObjectID, List[Callable[[], None]]] = {}
+
+    def _entry(self, object_id: ObjectID) -> _Entry:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                e = _Entry()
+                self._entries[object_id] = e
+            return e
+
+    def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
+        e = self._entry(object_id)
+        e.value = value
+        e.is_error = is_error
+        e.event.set()
+        self._fire(object_id)
+
+    def mark_in_shm(self, object_id: ObjectID) -> None:
+        e = self._entry(object_id)
+        e.in_shm = True
+        e.event.set()
+        self._fire(object_id)
+
+    def _fire(self, object_id: ObjectID) -> None:
+        with self._lock:
+            cbs = self._callbacks.pop(object_id, [])
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
+        return self._entry(object_id).event.wait(timeout)
+
+    def is_ready(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.event.is_set()
+
+    def get_if_ready(self, object_id: ObjectID) -> Optional[Tuple[Any, bool, bool]]:
+        """Returns (value, is_error, in_shm) or None if pending."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.event.is_set():
+                return None
+            return (e.value, e.is_error, e.in_shm)
+
+    def add_ready_callback(self, object_id: ObjectID, cb: Callable[[], None]) -> None:
+        e = self._entry(object_id)
+        with self._lock:
+            if e.event.is_set():
+                fire_now = True
+            else:
+                self._callbacks.setdefault(object_id, []).append(cb)
+                fire_now = False
+        if fire_now:
+            cb()
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entries.pop(object_id, None)
+            self._callbacks.pop(object_id, None)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)

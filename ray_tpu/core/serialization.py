@@ -1,0 +1,144 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Mirrors the reference's split (reference: python/ray/_private/serialization.py):
+ - metadata + pickled "in-band" bytes, plus a list of out-of-band buffers so
+   large numpy / jax host arrays are written into the object store without an
+   intermediate copy and read back zero-copy (mmap-backed views).
+ - nested ObjectRefs found during pickling are recorded so the owner can track
+   borrowers.
+
+Wire layout of a serialized object (the shm store stores exactly this):
+    [8B header: n_buffers u32 | inband_len u32]
+    [inband bytes]
+    for each buffer: [8B length][raw bytes, 64B-aligned start]
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+_ALIGN = 64
+_HEADER = struct.Struct("<II")
+_BUFLEN = struct.Struct("<Q")
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    __slots__ = ("inband", "buffers", "contained_refs")
+
+    def __init__(self, inband: bytes, buffers: List[pickle.PickleBuffer],
+                 contained_refs: list):
+        self.inband = inband
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        size = _HEADER.size + len(self.inband)
+        for buf in self.buffers:
+            raw = buf.raw()
+            size = _align(size) + _BUFLEN.size + raw.nbytes
+        return size
+
+    def write_to(self, dest: memoryview) -> int:
+        """Write the wire format into `dest`; returns bytes written."""
+        offset = 0
+        _HEADER.pack_into(dest, offset, len(self.buffers), len(self.inband))
+        offset += _HEADER.size
+        dest[offset:offset + len(self.inband)] = self.inband
+        offset += len(self.inband)
+        for buf in self.buffers:
+            raw = buf.raw()
+            offset = _align(offset)
+            _BUFLEN.pack_into(dest, offset, raw.nbytes)
+            offset += _BUFLEN.size
+            dest[offset:offset + raw.nbytes] = raw.cast("B")
+            offset += raw.nbytes
+        return offset
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes)
+        self.write_to(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    contained_refs: list = []
+
+    from ray_tpu.core.object_ref import ObjectRef
+
+    class _Pickler(cloudpickle.CloudPickler):
+        def persistent_id(self, obj):
+            return None
+
+        def reducer_override(self, obj):
+            if isinstance(obj, ObjectRef):
+                contained_refs.append(obj)
+            return NotImplemented
+
+    import io
+    out = io.BytesIO()
+    p = _Pickler(out, protocol=5, buffer_callback=buffers.append)
+    # jax.Array: move to host numpy before pickling so buffers are host memory.
+    p.dump(_prepare(value))
+    return SerializedObject(out.getvalue(), buffers, contained_refs)
+
+
+def _prepare(value: Any) -> Any:
+    """Convert device arrays to host-backed forms pre-pickle (shallow walk)."""
+    try:
+        import jax
+        if isinstance(value, jax.Array):
+            import numpy as np
+            return np.asarray(value)
+    except ImportError:
+        pass
+    return value
+
+
+def deserialize(data, position: int = 0) -> Any:
+    """Deserialize from a bytes-like (possibly an mmap view — zero copy).
+
+    Buffers are returned as memoryviews into `data`, so numpy arrays
+    reconstructed by pickle alias the store memory (reference behavior:
+    zero-copy numpy reads from plasma).
+    """
+    view = memoryview(data)
+    n_buffers, inband_len = _HEADER.unpack_from(view, position)
+    offset = position + _HEADER.size
+    inband = view[offset:offset + inband_len]
+    offset += inband_len
+    bufs = []
+    for _ in range(n_buffers):
+        offset = _align(offset)
+        (blen,) = _BUFLEN.unpack_from(view, offset)
+        offset += _BUFLEN.size
+        bufs.append(view[offset:offset + blen])
+        offset += blen
+    return pickle.loads(inband, buffers=bufs)
+
+
+# ---------------------------------------------------------------------------
+# Error payloads: stored objects can carry an exception instead of a value.
+# Metadata byte 0 distinguishes (0 = value, 1 = error pickled in-band).
+
+META_VALUE = 0
+META_ERROR = 1
+
+
+def serialize_error(exc: BaseException) -> SerializedObject:
+    from ray_tpu.exceptions import TaskError
+    if not isinstance(exc, TaskError):
+        exc = TaskError.from_exception(exc)
+    try:
+        return serialize(exc)
+    except Exception:
+        return serialize(TaskError(type(exc).__name__, repr(exc), "<unpicklable>"))

@@ -1,0 +1,47 @@
+"""Test fixtures.
+
+Mirrors the reference's conftest design (reference:
+python/ray/tests/conftest.py:532 ray_start_regular, :479 _ray_start):
+fixtures boot/teardown runtimes per test; JAX tests run on a virtual
+8-device CPU mesh (the reference's fake-multi-node trick applied to chips —
+SURVEY.md §4 item (d)).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rtpu_local():
+    import ray_tpu
+    ray_tpu.init(local_mode=True, num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rtpu_cluster():
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, _system_config={
+        "object_store_memory_bytes": 256 * 1024 * 1024,
+        "worker_pool_max": 4,
+    })
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must provide 8 virtual devices"
+    return devices

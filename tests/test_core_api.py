@@ -1,0 +1,262 @@
+"""Core API semantics in local mode.
+
+Covers what the reference's python/ray/tests/test_basic*.py cover for
+local-mode: put/get/wait, tasks, multiple returns, nested refs, errors,
+actors (state, ordering, named, kill), cancellation.
+"""
+
+import time
+
+import pytest
+
+
+def test_put_get(rtpu_local):
+    rt = rtpu_local
+    ref = rt.put({"a": [1, 2, 3]})
+    assert rt.get(ref) == {"a": [1, 2, 3]}
+
+
+def test_task_roundtrip(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def add(x, y):
+        return x + y
+
+    assert rt.get(add.remote(2, 3)) == 5
+
+
+def test_task_with_ref_args(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    a = rt.put(21)
+    assert rt.get(double.remote(a)) == 42
+    # chained
+    assert rt.get(double.remote(double.remote(a))) == 84
+
+
+def test_multiple_returns(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote(num_returns=2)
+    def divmod_task(a, b):
+        return a // b, a % b
+
+    q, r = divmod_task.remote(17, 5)
+    assert rt.get(q) == 3
+    assert rt.get(r) == 2
+
+
+def test_task_error_propagates(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(rt.exceptions.TaskError) as ei:
+        rt.get(boom.remote())
+    assert "kapow" in str(ei.value)
+
+
+def test_error_propagates_through_dependency(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def boom():
+        raise RuntimeError("first failure")
+
+    @rt.remote
+    def identity(x):
+        return x
+
+    with pytest.raises(rt.exceptions.TaskError):
+        rt.get(identity.remote(boom.remote()))
+
+
+def test_wait(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def fast():
+        return 1
+
+    @rt.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = rt.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert pending == [s]
+
+
+def test_get_timeout(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def sleeper():
+        time.sleep(10)
+
+    with pytest.raises(rt.exceptions.GetTimeoutError):
+        rt.get(sleeper.remote(), timeout=0.2)
+
+
+def test_actor_state_and_ordering(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.value = start
+
+        def incr(self, by=1):
+            self.value += by
+            return self.value
+
+        def get_value(self):
+            return self.value
+
+    c = Counter.remote(10)
+    refs = [c.incr.remote() for _ in range(20)]
+    assert rt.get(refs) == list(range(11, 31))
+    assert rt.get(c.get_value.remote()) == 30
+
+
+def test_actor_error_does_not_kill_actor(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    class A:
+        def ok(self):
+            return "ok"
+
+        def fail(self):
+            raise RuntimeError("method error")
+
+    a = A.remote()
+    with pytest.raises(rt.exceptions.TaskError):
+        rt.get(a.fail.remote())
+    assert rt.get(a.ok.remote()) == "ok"
+
+
+def test_named_actor(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get(self, k):
+            return self.d.get(k)
+
+    Store.options(name="kv").remote()
+    handle = rt.get_actor("kv")
+    rt.get(handle.set.remote("x", 7))
+    assert rt.get(handle.get.remote("x")) == 7
+
+
+def test_kill_actor(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert rt.get(a.ping.remote()) == "pong"
+    rt.kill(a)
+    time.sleep(0.1)
+    with pytest.raises(rt.exceptions.ActorError):
+        rt.get(a.ping.remote(), timeout=5)
+
+
+def test_actor_handle_passing(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    @rt.remote
+    def bump(counter):
+        return rt.get(counter.incr.remote())
+
+    c = Counter.remote()
+    results = rt.get([bump.remote(c) for _ in range(5)])
+    assert sorted(results) == [1, 2, 3, 4, 5]
+
+
+def test_options_override(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def f():
+        return "x"
+
+    ref = f.options(name="custom", num_returns=1).remote()
+    assert rt.get(ref) == "x"
+
+
+def test_runtime_context(rtpu_local):
+    rt = rtpu_local
+    ctx = rt.get_runtime_context()
+    assert not ctx.job_id.is_nil()
+    assert len(ctx.get()["worker_id"]) == 32
+
+
+def test_nested_tasks(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    def inner(x):
+        return x * 2
+
+    @rt.remote
+    def outer(x):
+        return rt.get(inner.remote(x)) + 1
+
+    assert rt.get(outer.remote(10)) == 21
+
+
+def test_cluster_resources_local(rtpu_local):
+    rt = rtpu_local
+    assert rt.cluster_resources()["CPU"] == 4.0
+
+
+def test_method_decorator_num_returns(rtpu_local):
+    rt = rtpu_local
+
+    @rt.remote
+    class M:
+        @rt.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    m = M.remote()
+    a, b = m.pair.remote()
+    assert rt.get(a) == 1
+    assert rt.get(b) == 2
+
+
+def test_wait_caps_ready_at_num_returns(rtpu_local):
+    rt = rtpu_local
+    refs = [rt.put(i) for i in range(5)]
+    ready, pending = rt.wait(refs, num_returns=2, timeout=5)
+    assert len(ready) == 2
+    assert len(pending) == 3

@@ -1,0 +1,192 @@
+"""C++ shm store + scheduler unit tests (interface-seamed, no cluster).
+
+Mirrors the reference's colocated C++ unit test strategy (SURVEY.md §4.1 —
+plasma tests at src/ray/object_manager/plasma/test/, scheduler policy tests
+at src/ray/raylet/scheduling/*_test.cc) at the binding layer.
+"""
+
+import os
+
+import pytest
+
+from ray_tpu.core._native import (ClusterState, ObjectExists, ObjectStoreFull,
+                                  ShmStore)
+
+
+@pytest.fixture
+def store():
+    name = f"/rtpu_test_{os.getpid()}"
+    s = ShmStore.create(name, 8 * 1024 * 1024, slots=1024)
+    yield s
+    s.close()
+    ShmStore.attach(name).unlink()
+
+
+def _oid(i: int) -> bytes:
+    return i.to_bytes(28, "little")
+
+
+class TestShmStore:
+    def test_put_get_roundtrip(self, store):
+        store.put(_oid(1), b"abc" * 1000)
+        view = store.get(_oid(1))
+        assert bytes(view[:3000]) == b"abc" * 1000
+        store.release(_oid(1))
+
+    def test_get_missing_returns_none(self, store):
+        assert store.get(_oid(99)) is None
+
+    def test_unsealed_invisible(self, store):
+        buf = store.create_object(_oid(2), 100)
+        assert store.get(_oid(2)) is None
+        assert not store.contains(_oid(2))
+        store.seal(_oid(2))
+        assert store.contains(_oid(2))
+
+    def test_duplicate_create_raises(self, store):
+        store.put(_oid(3), b"x")
+        with pytest.raises(ObjectExists):
+            store.create_object(_oid(3), 10)
+
+    def test_zero_copy_write(self, store):
+        buf = store.create_object(_oid(4), 8)
+        memoryview(buf).cast("B")[:] = b"12345678"
+        store.seal(_oid(4))
+        v = store.get(_oid(4))
+        assert bytes(v[:8]) == b"12345678"
+        store.release(_oid(4))
+
+    def test_cross_attach_visibility(self, store):
+        store.put(_oid(5), b"shared")
+        other = ShmStore.attach(store.name)
+        v = other.get(_oid(5))
+        assert bytes(v[:6]) == b"shared"
+        other.release(_oid(5))
+        other.close()
+
+    def test_delete_pinned_is_deferred(self, store):
+        store.put(_oid(6), b"pinned")  # creator pin still held
+        assert not store.delete(_oid(6))  # -> delete_pending
+        assert store.contains(_oid(6))
+        store.release(_oid(6))  # last pin drops -> deleted
+        assert not store.contains(_oid(6))
+
+    def test_eviction_under_pressure(self, store):
+        blob = b"z" * (1024 * 1024)
+        for i in range(20):
+            store.put(_oid(100 + i), blob)
+            store.release(_oid(100 + i))  # unpinned -> evictable
+        stats = store.stats()
+        assert stats["total_evicted"] > 0
+        # most recent objects survive
+        assert store.contains(_oid(119))
+
+    def test_pinned_objects_never_evicted(self, store):
+        blob = b"z" * (1024 * 1024)
+        store.put(_oid(50), blob)  # keep creator pin
+        for i in range(20):
+            store.put(_oid(200 + i), blob)
+            store.release(_oid(200 + i))
+        assert store.contains(_oid(50))
+
+    def test_store_full_when_all_pinned(self, store):
+        blob = b"z" * (1024 * 1024)
+        with pytest.raises(ObjectStoreFull):
+            for i in range(20):
+                store.put(_oid(300 + i), blob)  # all pinned
+
+    def test_stats(self, store):
+        store.put(_oid(7), b"abc")
+        st = store.stats()
+        assert st["num_objects"] == 1
+        assert st["total_created"] == 1
+        assert st["capacity"] == 8 * 1024 * 1024
+
+
+class TestClusterState:
+    def test_schedule_respects_feasibility(self):
+        c = ClusterState()
+        c.add_node("n1", {"CPU": 4})
+        c.add_node("n2", {"CPU": 4, "TPU": 8})
+        assert c.schedule({"TPU": 4}) == "n2"
+        assert c.schedule({"GPU": 1}) is None
+
+    def test_hybrid_packs_then_spreads(self):
+        c = ClusterState()
+        c.add_node("a", {"CPU": 10})
+        c.add_node("b", {"CPU": 10})
+        # first task: both empty — picks one; acquire and check consolidation
+        first = c.schedule({"CPU": 1})
+        assert c.acquire(first, {"CPU": 1})
+        second = c.schedule({"CPU": 1})
+        assert second == first  # pack below threshold
+
+    def test_acquire_release(self):
+        c = ClusterState()
+        c.add_node("n", {"CPU": 2})
+        assert c.acquire("n", {"CPU": 2})
+        assert c.schedule({"CPU": 1}) is None
+        c.release("n", {"CPU": 2})
+        assert c.schedule({"CPU": 1}) == "n"
+
+    def test_fractional_resources(self):
+        c = ClusterState()
+        c.add_node("n", {"CPU": 1})
+        for _ in range(4):
+            assert c.acquire("n", {"CPU": 0.25})
+        assert c.schedule({"CPU": 0.25}) is None
+
+    def test_strict_spread_distinct_nodes(self):
+        c = ClusterState()
+        c.add_node("x", {"CPU": 4})
+        c.add_node("y", {"CPU": 4})
+        c.add_node("z", {"CPU": 4})
+        nodes = c.schedule_bundles([{"CPU": 2}] * 3, "STRICT_SPREAD")
+        assert sorted(nodes) == ["x", "y", "z"]
+
+    def test_strict_spread_infeasible(self):
+        c = ClusterState()
+        c.add_node("x", {"CPU": 4})
+        assert c.schedule_bundles([{"CPU": 2}] * 2, "STRICT_SPREAD") is None
+
+    def test_strict_pack_one_node(self):
+        c = ClusterState()
+        c.add_node("x", {"CPU": 2})
+        c.add_node("y", {"CPU": 8})
+        nodes = c.schedule_bundles([{"CPU": 3}, {"CPU": 3}], "STRICT_PACK")
+        assert nodes == ["y", "y"]
+
+    def test_bundles_all_or_nothing(self):
+        c = ClusterState()
+        c.add_node("x", {"CPU": 4})
+        before = c.schedule({"CPU": 4})  # feasible now
+        assert before == "x"
+        assert c.schedule_bundles([{"CPU": 3}, {"CPU": 3}], "PACK") is None
+        # nothing was deducted
+        assert c.schedule({"CPU": 4}) == "x"
+
+    def test_node_affinity(self):
+        from ray_tpu.core._native import POLICY_NODE_AFFINITY
+        c = ClusterState()
+        c.add_node("n1", {"CPU": 4})
+        c.add_node("n2", {"CPU": 4})
+        assert c.schedule({"CPU": 1}, POLICY_NODE_AFFINITY, "n2") == "n2"
+        c.acquire("n2", {"CPU": 4})
+        # hard affinity fails, soft falls back
+        assert c.schedule({"CPU": 1}, POLICY_NODE_AFFINITY, "n2") is None
+        assert c.schedule({"CPU": 1}, POLICY_NODE_AFFINITY, "n2",
+                          soft=True) == "n1"
+
+    def test_remove_node(self):
+        c = ClusterState()
+        c.add_node("n1", {"CPU": 4})
+        c.remove_node("n1")
+        assert c.schedule({"CPU": 1}) is None
+        assert c.num_nodes() == 0
+
+    def test_tpu_gang_resources(self):
+        # TPU slice head resource pattern (reference: accelerators/tpu.py:330)
+        c = ClusterState()
+        c.add_node("host0", {"CPU": 8, "TPU": 4, "TPU-v5p-16-head": 1})
+        c.add_node("host1", {"CPU": 8, "TPU": 4})
+        assert c.schedule({"TPU-v5p-16-head": 1}) == "host0"
