@@ -1,0 +1,31 @@
+"""ray_tpu.parallel — TPU mesh / sharding / collective layer.
+
+This is the TPU-native replacement for the reference's NCCL-era stack
+(reference: python/ray/util/collective/collective.py, train/torch/config.py:66):
+instead of a runtime collective library, parallelism here is expressed as a
+device mesh plus named shardings, and XLA compiles the collectives over ICI.
+
+Axes convention (outermost → innermost, matching ICI locality):
+    pp    pipeline stages (slowest; DCN-friendly across slices)
+    dp    pure data parallel (gradient psum)
+    fsdp  ZeRO-3 style parameter sharding (all-gather params, reduce-scatter grads)
+    sp    sequence/context parallel (ring attention / Ulysses)
+    tp    tensor parallel (innermost — highest-bandwidth ICI)
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    local_device_count,
+    named_sharding,
+    shard_constraint,
+)
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.ulysses import ulysses_attention
+from ray_tpu.parallel.pipeline import pipeline_apply
+
+__all__ = [
+    "MeshSpec", "build_mesh", "local_device_count", "named_sharding",
+    "shard_constraint", "ring_attention", "ulysses_attention",
+    "pipeline_apply",
+]

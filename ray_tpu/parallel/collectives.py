@@ -1,0 +1,53 @@
+"""Named collective helpers lowering to XLA collectives.
+
+API-parity layer for the reference's `ray.util.collective`
+(reference: python/ray/util/collective/collective.py:258,423,472 —
+allreduce/allgather/reducescatter over NCCL/Gloo groups). On TPU these are
+not runtime calls: inside jit/shard_map they compile to ICI collectives.
+The host-side group API for actors lives in ray_tpu.util.collective; this
+module is the in-program (traced) surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce(x, axis_name: str, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    raise ValueError(f"unknown op {op!r}")
+
+
+def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reducescatter(x, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    """Every shard receives root's value (select + psum)."""
+    idx = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis_name)
+
+
+def alltoall(x, axis_name: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute_shift(x, axis_name: str, shift: int = 1):
+    """Rotate values around the axis ring by `shift` (send/recv pair)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

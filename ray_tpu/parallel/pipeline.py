@@ -1,0 +1,65 @@
+"""GPipe-style pipeline parallelism over the `pp` mesh axis.
+
+The reference only carries pipeline degree as a config knob handed to vLLM
+(reference: python/ray/llm/_internal/serve/configs/vllm_models.py:133);
+there is no in-tree schedule. TPU-native design: every `pp` shard holds its
+stage's parameters, activations hop stage→stage via `lax.ppermute`, and a
+single `lax.scan` of length (n_micro + n_stages - 1) runs the fill/steady/
+drain schedule. `jax.grad` through the scan+ppermute yields the backward
+pipeline automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.collectives import ppermute_shift
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches: jax.Array,
+                   axis_name: str = "pp") -> jax.Array:
+    """Run microbatches through the pipeline; call INSIDE shard_map.
+
+    stage_fn(stage_params, x) -> y : applies this shard's stage (same output
+        shape as input — the inter-stage activation contract).
+    microbatches: [n_micro, ...] stacked microbatch activations. Stage 0
+        consumes them; later stages ignore their copy.
+    Returns [n_micro, ...] outputs of the LAST stage, psum-broadcast to all
+    stages so every shard can compute the same loss.
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    total_steps = n_micro + n_stages - 1
+
+    state0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+
+    def step(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped during drain steps).
+        inj = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        x = jnp.where(stage == 0, inj, state)
+        y = stage_fn(stage_params, x)
+        # Last stage emits microbatch (t - (n_stages-1)) during drain window.
+        out_idx = t - (n_stages - 1)
+        emit = (stage == n_stages - 1) & (out_idx >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(emit, y, lax.dynamic_index_in_dim(
+                outputs, jnp.clip(out_idx, 0, n_micro - 1), 0,
+                keepdims=False)),
+            jnp.clip(out_idx, 0, n_micro - 1), 0)
+        state = ppermute_shift(y, axis_name)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(step, (state0, outputs0), jnp.arange(total_steps))
+    # Broadcast last stage's outputs to every stage (zeros elsewhere → psum).
+    return lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)

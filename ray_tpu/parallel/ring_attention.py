@@ -1,0 +1,101 @@
+"""Ring attention: blockwise attention with KV rotation over an ICI ring.
+
+The reference has NO sequence-parallel implementation (SURVEY.md §2.6 —
+long-context is delegated to vLLM on GPU). This is the TPU-native design:
+each `sp` shard holds a contiguous sequence block; KV blocks rotate around
+the ring via `lax.ppermute` while each shard accumulates blockwise softmax
+statistics online (flash-attention style, fp32 accumulators). XLA overlaps
+the ppermute with the einsums; a Pallas fused kernel can swap in for the
+per-block compute without changing this orchestration.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.collectives import ppermute_shift
+from ray_tpu.parallel.mesh import shard_map_compat
+
+_NEG_INF = float("-inf")
+
+
+def _block_update(o, m, l, s, v):
+    """One online-softmax accumulation step.
+
+    o: [B,Lq,H,D] f32 running numerator; m,l: [B,H,Lq] running max / denom;
+    s: [B,H,Lq,Lk] scores (may contain -inf for masked); v: [B,Lk,H,D].
+    """
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp(s - m_new) with fully-masked entries forced to 0 (avoids inf-inf=nan).
+    p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_new[..., None]))
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_new))
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over `axis_name`; call INSIDE shard_map/pjit manual axes.
+
+    q, k, v: [batch, seq_local, heads, head_dim], contiguous seq blocks in
+    ring order along `axis_name`. Returns [batch, seq_local, heads, head_dim].
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    qf = q.astype(jnp.float32) * sm_scale
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    qpos = idx * Lq + jnp.arange(Lq)
+
+    def step(carry, t):
+        o, m, l, kt, vt = carry
+        src = (idx - t) % n  # ring origin of the KV block currently held
+
+        def attend(oml):
+            o, m, l = oml
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, kt.astype(jnp.float32))
+            if causal:
+                kpos = src * Lk + jnp.arange(Lk)
+                mask = qpos[:, None] >= kpos[None, :]
+                s = jnp.where(mask[None, None], s, _NEG_INF)
+            return _block_update(o, m, l, s, vt)
+
+        if causal:
+            # Blocks strictly in the future (src > idx) are fully masked —
+            # skip their FLOPs entirely; only the ppermute below still runs.
+            o, m, l = lax.cond(src <= idx, attend, lambda oml: oml, (o, m, l))
+        else:
+            o, m, l = attend((o, m, l))
+        kt = ppermute_shift(kt, axis_name)
+        vt = ppermute_shift(vt, axis_name)
+        return (o, m, l, kt, vt), None
+
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, causal: bool = True,
+                           seq_axis: str = "sp", head_axis: str = "tp",
+                           batch_axes=("dp", "fsdp")) -> jax.Array:
+    """shard_map wrapper: seq sharded on `seq_axis`, heads on `head_axis`."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = shard_map_compat(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

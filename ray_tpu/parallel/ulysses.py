@@ -1,0 +1,61 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+Alternative to ring attention (SURVEY.md §2.6 row SP/CP — absent in the
+reference): shards hold sequence blocks; an `all_to_all` regathers the full
+sequence while splitting heads across the axis, full attention runs locally
+per head group, and a second `all_to_all` restores sequence sharding.
+Best when heads >= axis size and ICI all-to-all bandwidth is plentiful.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import shard_map_compat
+
+
+def _full_causal_attention(q, k, v, sm_scale):
+    s = jnp.einsum("bqhd,bkhd->bhqk",
+                   q.astype(jnp.float32) * sm_scale, k.astype(jnp.float32))
+    L = q.shape[1]
+    mask = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(mask[None, None], s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp", causal: bool = True,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Call INSIDE shard_map. q/k/v: [B, seq_local, H, D]; H % axis_size == 0."""
+    if not causal:
+        raise NotImplementedError("ulysses_attention is causal-only for now")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = lax.psum(1, axis_name)  # static under shard_map
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[2]}) divisible by "
+            f"the {axis_name!r} axis size ({n})")
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    # [B, L/n, H, D] -> [B, L, H/n, D]: gather seq, scatter heads.
+    qg, kg, vg = (a2a(x, split_axis=2, concat_axis=1) for x in (q, k, v))
+    og = _full_causal_attention(qg, kg, vg, sm_scale)
+    # [B, L, H/n, D] -> [B, L/n, H, D]
+    return a2a(og, split_axis=1, concat_axis=2).astype(q.dtype)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, *, seq_axis: str = "sp",
+                              head_axis: str = "tp",
+                              batch_axes=("dp", "fsdp")) -> jax.Array:
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = shard_map_compat(
+        functools.partial(ulysses_attention, axis_name=seq_axis),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

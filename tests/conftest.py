@@ -9,13 +9,19 @@ SURVEY.md §4 item (d)).
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The suite runs on a virtual 8-device CPU mesh. The ambient sandbox pins
+# the real-TPU platform via sitecustomize (env vars alone don't stick), so
+# override at the jax.config level before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
