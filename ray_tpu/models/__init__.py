@@ -1,0 +1,22 @@
+"""ray_tpu.models — flagship model family (functional JAX, mesh-shardable).
+
+The reference delegates model code to torch/vLLM; here models are first-class
+TPU citizens: pure functions over parameter pytrees with matching
+PartitionSpec pytrees, scan-over-layers + remat, bf16 compute / fp32 master
+params, and attention selectable between full, ring (sequence-parallel over
+ICI) and Ulysses all-to-all.
+"""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    param_specs,
+    forward,
+    loss_fn,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_apply
+
+__all__ = [
+    "LlamaConfig", "init_params", "param_specs", "forward", "loss_fn",
+    "MLPConfig", "mlp_init", "mlp_apply",
+]

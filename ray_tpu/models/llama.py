@@ -1,0 +1,307 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design (contrast reference: models live outside the tree in torch/vLLM —
+SURVEY.md §2.5 Ray LLM row):
+  * pure functions: `init_params` → pytree, `forward(params, tokens)` → logits
+  * `param_specs` returns a PartitionSpec pytree aligned leaf-for-leaf with
+    params — fsdp shards the embed/ffn input dims, tp shards heads/ffn
+    hidden, pp shards the stacked layer dim
+  * layers are STACKED on axis 0 and applied with `lax.scan` + remat: one
+    compiled layer body regardless of depth (XLA-friendly, constant compile
+    time), and the stack shards over `pp` for pipeline parallelism
+  * attention: "full" (GSPMD auto-sharded), "ring" (manual `sp` ring over
+    ICI — ray_tpu.parallel.ring_attention), or "ulysses" (all-to-all)
+  * bf16 activations/compute, fp32 params & softmax/logit accumulators
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.attention import causal_attention
+from ray_tpu.parallel.mesh import shard_map_compat
+from ray_tpu.parallel.pipeline import pipeline_apply
+from ray_tpu.parallel.ring_attention import (ring_attention,
+                                             ring_attention_sharded)
+from ray_tpu.parallel.ulysses import ulysses_attention_sharded
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    attention: str = "full"          # full | ring | ulysses
+    dtype: Any = jnp.bfloat16        # activation/compute dtype
+    param_dtype: Any = jnp.float32   # master weights
+    remat: bool = True
+    pp_microbatches: int = 4         # microbatch count when pp > 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-scale config for the virtual CPU mesh."""
+        base = dict(vocab_size=256, dim=64, n_layers=4, n_heads=8,
+                    n_kv_heads=4, ffn_dim=128, rope_theta=10000.0)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=8, ffn_dim=14336)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    ks = jax.random.split(key, 8)
+    d, L = cfg.dim, cfg.n_layers
+    hq, hkv, hd, f = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim
+    pd = cfg.param_dtype
+
+    def norm_init(*shape):
+        return jnp.ones(shape, pd)
+
+    def dense(k, *shape, fan_in=None):
+        fan_in = fan_in if fan_in is not None else shape[-2]
+        return (jax.random.normal(k, shape) * (fan_in ** -0.5)).astype(pd)
+
+    return {
+        "embed": dense(ks[0], cfg.vocab_size, d, fan_in=d),
+        "layers": {
+            "attn_norm": norm_init(L, d),
+            "wq": dense(ks[1], L, d, hq * hd),
+            "wk": dense(ks[2], L, d, hkv * hd),
+            "wv": dense(ks[3], L, d, hkv * hd),
+            "wo": dense(ks[4], L, hq * hd, d),
+            "mlp_norm": norm_init(L, d),
+            "w_gate": dense(ks[5], L, d, f),
+            "w_up": dense(ks[6], L, d, f),
+            "w_down": dense(ks[7], L, f, d),
+        },
+        "final_norm": norm_init(d),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec pytree aligned with init_params' output.
+
+    Stacked layer dim shards over pp; matmul input dims over fsdp (ZeRO-3
+    gather), head/ffn-hidden dims over tp (Megatron) — the §2.6 inventory's
+    TPU-native equivalents.
+    """
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": {
+            "attn_norm": P("pp", None),
+            "wq": P("pp", "fsdp", "tp"),
+            "wk": P("pp", "fsdp", "tp"),
+            "wv": P("pp", "fsdp", "tp"),
+            "wo": P("pp", "tp", "fsdp"),
+            "mlp_norm": P("pp", None),
+            "w_gate": P("pp", "fsdp", "tp"),
+            "w_up": P("pp", "fsdp", "tp"),
+            "w_down": P("pp", "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+    }
+
+
+def _rmsnorm(x, w, eps):
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding; x: [B, L, H, D_even], positions: [L] or [B, L]."""
+    d2 = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
+    ang = positions[..., None].astype(jnp.float32) * freqs  # [..., L, d2]
+    if ang.ndim == 2:  # [L, d2] → broadcast over batch
+        ang = ang[None]
+    cos, sin = jnp.cos(ang)[:, :, None, :], jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _full_attention(q, k, v):
+    """Causal attention (shared fp32 kernel), output in q's dtype."""
+    return causal_attention(q, k, v).astype(q.dtype)
+
+
+def _layer(lp: Params, x, cfg: LlamaConfig, positions, attn_fn):
+    """One transformer block; lp leaves have the layer axis removed."""
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    B, L, _ = x.shape
+    cd = cfg.dtype
+
+    h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(cd)).reshape(B, L, hq, hd)
+    k = (h @ lp["wk"].astype(cd)).reshape(B, L, hkv, hd)
+    v = (h @ lp["wv"].astype(cd)).reshape(B, L, hkv, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if hkv != hq:  # GQA: repeat KV groups to full head count
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    o = attn_fn(q, k, v).reshape(B, L, hq * hd)
+    x = x + (o @ lp["wo"].astype(cd))
+
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(cd))
+    up = h @ lp["w_up"].astype(cd)
+    x = x + ((gate * up) @ lp["w_down"].astype(cd))
+    return x
+
+
+def _scan_layers(layers: Params, x, cfg: LlamaConfig, positions, attn_fn):
+    body = functools.partial(_layer, cfg=cfg, positions=positions,
+                             attn_fn=attn_fn)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def step(x, lp):
+        return body(lp, x), None
+
+    x, _ = lax.scan(step, x, layers)
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            mesh=None) -> jax.Array:
+    """tokens [B, L] int32 → logits [B, L, vocab] (fp32).
+
+    mesh is required for ring/ulysses attention and for pp > 1 (the stacked
+    layer axis sharded over 'pp'); with attention='full' and pp==1 the whole
+    forward is a single GSPMD program.
+    """
+    B, L = tokens.shape
+    cd = cfg.dtype
+    x = params["embed"].astype(cd)[tokens]
+    positions = jnp.arange(L)
+
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        x = _forward_pipelined(params, x, cfg, mesh, positions)
+    else:
+        attn_fn = _make_attn_fn(cfg, mesh)
+        x = _scan_layers(params["layers"], x, cfg, positions, attn_fn)
+
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    # Tied embeddings: logits = x · embedᵀ, fp32 accumulation on the MXU.
+    return jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
+                      params["embed"].astype(jnp.float32))
+
+
+def _make_attn_fn(cfg: LlamaConfig, mesh):
+    if cfg.attention == "full":
+        return _full_attention
+    if mesh is None:
+        raise ValueError(f"attention={cfg.attention!r} needs a mesh")
+    if cfg.attention == "ring":
+        return functools.partial(ring_attention_sharded, mesh=mesh)
+    if cfg.attention == "ulysses":
+        return functools.partial(ulysses_attention_sharded, mesh=mesh)
+    raise ValueError(f"unknown attention {cfg.attention!r}")
+
+
+def _forward_pipelined(params: Params, x, cfg: LlamaConfig, mesh, positions):
+    """pp > 1: microbatch the batch dim, run stages over the 'pp' axis.
+
+    The stacked layer axis is ALREADY sharded over pp (param_specs), so each
+    stage's shard_map block holds n_layers/pp layers; activations hop via
+    ppermute inside pipeline_apply. Embedding/head stay outside the pipeline
+    (they are not stage-shaped — same trick as classic GPipe embeddings).
+    Manual axes: {'pp'} (+'sp' for ring attention); fsdp/tp stay GSPMD-auto.
+    """
+    B, L, D = x.shape
+    M = min(cfg.pp_microbatches, B)
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by pp_microbatches {M}")
+    xm = x.reshape(M, B // M, L, D)
+
+    manual = {"pp"}
+    if cfg.attention == "ring":
+        manual.add("sp")
+
+        def attn_fn(q, k, v):
+            return ring_attention(q, k, v, axis_name="sp")
+    elif cfg.attention == "full":
+        attn_fn = _full_attention
+    else:
+        raise ValueError("pp>1 supports attention in {'full','ring'}")
+
+    seq_dim_spec = "sp" if "sp" in manual else None
+
+    def run(layers, xm):
+        def stage_fn(layers, xb):
+            Lloc = xb.shape[1]
+            if "sp" in manual:
+                off = lax.axis_index("sp") * Lloc
+            else:
+                off = 0
+            pos = off + jnp.arange(Lloc)
+            return _scan_layers(layers, xb, cfg, pos, attn_fn)
+
+        return pipeline_apply(stage_fn, layers, xm, axis_name="pp")
+
+    # Partial-manual shard_map: specs may ONLY name the manual axes; the
+    # dp/fsdp batch sharding stays GSPMD-auto and flows through untouched.
+    xspec = P(None, None, seq_dim_spec, None)
+    lspec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    out = shard_map_compat(run, mesh=mesh,
+                           in_specs=(lspec, xspec), out_specs=xspec,
+                           axis_names=manual)(params["layers"], xm)
+    return out.reshape(B, L, D)
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            mesh=None) -> jax.Array:
+    """Next-token cross-entropy (mean over B×(L-1) positions), fp32.
+
+    The FULL sequence goes through forward (keeps L divisible by the sp
+    axis for ring/ulysses); the shift happens on logits afterwards.
+    """
+    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    d, L, f = cfg.dim, cfg.n_layers, cfg.ffn_dim
+    hd = cfg.head_dim
+    per_layer = (d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd
+                 + cfg.n_heads * hd * d + 3 * d * f + 2 * d)
+    return cfg.vocab_size * d + L * per_layer + d
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approx training FLOPs/token: 6·N_params + attention score term.
+
+    The embed matrix counts: it is tied as the LM head, so its matmul runs.
+    """
+    attn = 12 * cfg.n_layers * cfg.dim * seq_len  # fwd+bwd qk+pv scores
+    return 6.0 * num_params(cfg) + attn

@@ -1,0 +1,60 @@
+"""Minimal MLP classifier — the MNIST-scale model of the Train MVP slice
+(SURVEY.md §7 minimum end-to-end slice; reference equivalent: the torch MLP
+configs driven through DataParallelTrainer, train/data_parallel_trainer.py:26).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: int = 256
+    n_hidden: int = 2
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(cfg: MLPConfig, key: jax.Array):
+    dims = [cfg.in_dim] + [cfg.hidden] * cfg.n_hidden + [cfg.out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    params = []
+    for k, (din, dout) in zip(keys, zip(dims[:-1], dims[1:])):
+        params.append({
+            "w": (jax.random.normal(k, (din, dout)) * din ** -0.5
+                  ).astype(cfg.dtype),
+            "b": jnp.zeros((dout,), cfg.dtype),
+        })
+    return params
+
+
+def mlp_specs(cfg: MLPConfig):
+    """Hidden dims shard over tp; replicate the rest (dp/fsdp shard data)."""
+    specs = []
+    dims = [cfg.in_dim] + [cfg.hidden] * cfg.n_hidden + [cfg.out_dim]
+    for din, dout in zip(dims[:-1], dims[1:]):
+        specs.append({"w": P(None, "tp"), "b": P("tp")})
+    specs[-1] = {"w": P(None, None), "b": P(None)}
+    return specs
+
+
+def mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, batch):
+    x, y = batch
+    logits = mlp_apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()

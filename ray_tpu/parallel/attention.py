@@ -1,0 +1,25 @@
+"""Shared attention math used by the full/ring/ulysses paths.
+
+One copy of the numerically-sensitive fp32 causal-softmax kernel so the
+parallel strategies can't drift apart; Pallas fused variants drop in here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention(q, k, v, sm_scale: Optional[float] = None) -> jax.Array:
+    """q/k/v: [B, L, H, D] → [B, L, H, D] fp32; fp32 scores/softmax."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk",
+                   q.astype(jnp.float32) * sm_scale, k.astype(jnp.float32))
+    Lq, Lk = q.shape[1], k.shape[1]
+    mask = jnp.tril(jnp.ones((Lq, Lk), bool))
+    s = jnp.where(mask[None, None], s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))

@@ -89,18 +89,32 @@ def build_mesh(spec: MeshSpec,
     return Mesh(dev_array, AXIS_ORDER)
 
 
-def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
-    """shard_map across jax versions (check_rep → check_vma rename)."""
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs, axis_names=None):
+    """shard_map across jax versions (check_rep → check_vma rename).
+
+    axis_names: optional set of mesh axes to treat as MANUAL; the rest stay
+    auto (GSPMD keeps sharding them) — used to run the pipeline/ring loops
+    manually while fsdp/tp remain compiler-managed.
+    """
     try:
         from jax import shard_map as _sm
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map as _sm
-    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
-        try:
-            return _sm(fn, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, **kw)
-        except TypeError:
-            continue
+    partial_variants = [{}]
+    if axis_names is not None:
+        # jax>=0.8 spells partial-manual as axis_names={manual}; older
+        # jax.experimental.shard_map spells it auto={the rest}.
+        partial_variants = [
+            {"axis_names": set(axis_names)},
+            {"auto": frozenset(mesh.axis_names) - set(axis_names)},
+        ]
+    for extra in partial_variants:
+        for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+            try:
+                return _sm(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw, **extra)
+            except TypeError:
+                continue
     raise RuntimeError("no compatible shard_map signature found")
 
 

@@ -17,17 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu.parallel.attention import causal_attention
 from ray_tpu.parallel.mesh import shard_map_compat
-
-
-def _full_causal_attention(q, k, v, sm_scale):
-    s = jnp.einsum("bqhd,bkhd->bhqk",
-                   q.astype(jnp.float32) * sm_scale, k.astype(jnp.float32))
-    L = q.shape[1]
-    mask = jnp.tril(jnp.ones((L, L), bool))
-    s = jnp.where(mask[None, None], s, float("-inf"))
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -46,7 +37,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # [B, L/n, H, D] -> [B, L, H/n, D]: gather seq, scatter heads.
     qg, kg, vg = (a2a(x, split_axis=2, concat_axis=1) for x in (q, k, v))
-    og = _full_causal_attention(qg, kg, vg, sm_scale)
+    og = causal_attention(qg, kg, vg, sm_scale)
     # [B, L, H/n, D] -> [B, L/n, H, D]
     return a2a(og, split_axis=1, concat_axis=2).astype(q.dtype)
 
