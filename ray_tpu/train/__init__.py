@@ -1,0 +1,25 @@
+"""ray_tpu.train — distributed training orchestration (JaxTrainer).
+
+Modeled on the reference's Train v2 (SURVEY.md §3.4: decoupled controller
+state machine, reference: python/ray/train/v2/_internal/execution/
+controller/controller.py:91), not v1-over-Tune. The compute path is JAX
+SPMD over a TPU mesh: the trainer owns mesh construction + jax.distributed
+bootstrap, workers run one process per host, and the train step is a single
+pjit program (FSDP/TP/PP/SP via ray_tpu.parallel).
+"""
+
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.result import Result
+from ray_tpu.train.session import (TrainContext, get_context, report,
+                                   get_checkpoint)
+from ray_tpu.train.train_step import make_train_step, shard_params
+from ray_tpu.train.trainer import JaxTrainer
+
+__all__ = [
+    "JaxTrainer", "RunConfig", "ScalingConfig", "FailureConfig",
+    "CheckpointConfig", "Checkpoint", "Result", "TrainContext",
+    "get_context", "get_checkpoint", "report", "make_train_step",
+    "shard_params",
+]

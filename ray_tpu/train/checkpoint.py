@@ -1,0 +1,199 @@
+"""Checkpoint: directory-backed pytree snapshots.
+
+Reference equivalents: python/ray/train/_checkpoint.py (Checkpoint as a
+directory handle) + train/_internal/storage.py (StorageContext). TPU-native
+twist: the payload is a JAX pytree — arrays are gathered from the mesh
+(device_get) and stored as one .npz plus a JSON treedef, so restore can
+re-shard onto a *different* mesh (elastic recovery, SURVEY.md §5
+checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_TREE_FILE = "tree.json"
+_ARRAYS_FILE = "arrays.npz"
+_METRICS_FILE = "metrics.json"
+
+
+def _esc(key: str) -> str:
+    """Escape the path separators; keys like haiku's 'mlp/~/linear_0' survive."""
+    return key.replace("%", "%25").replace("/", "%2F").replace(":", "%3A")
+
+
+def _unesc(key: str) -> str:
+    return key.replace("%3A", ":").replace("%2F", "/").replace("%25", "%")
+
+
+def _flatten(tree, prefix=""):
+    """Flatten nested dict/list/tuple pytrees into {path: leaf}."""
+    out = {}
+    if isinstance(tree, dict) and tree:
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], f"{prefix}d:{_esc(str(k))}/"))
+    elif isinstance(tree, (list, tuple)) and tree:
+        tag = "l" if isinstance(tree, list) else "t"
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{tag}:{i}/"))
+    elif isinstance(tree, (dict, list, tuple)):  # empty container leaf
+        out[prefix + {dict: "d", list: "l", tuple: "t"}[type(tree)] + ":<empty>"] = None
+    else:
+        out[prefix.rstrip("/")] = tree
+    return out
+
+
+class _Node(dict):
+    pass
+
+
+def _unflatten(flat: Dict[str, Any]):
+    """Inverse of _flatten: paths are '/'-joined 'kind:key' tokens."""
+    if "" in flat:  # bare top-level leaf
+        return flat[""]
+    root = _Node()
+    for path, leaf in flat.items():
+        parts = path.split("/")
+        node = root
+        for tok in parts[:-1]:
+            node = node.setdefault(tok, _Node())
+        node[parts[-1]] = leaf
+
+    def convert(node):
+        if not isinstance(node, _Node):
+            return node
+        kinds = {tok.split(":", 1)[0] for tok in node}
+        if len(kinds) != 1:
+            raise ValueError(f"mixed container kinds at one node: {kinds}")
+        kind = kinds.pop()
+        if set(node) == {f"{kind}:<empty>"}:
+            return {"d": {}, "l": [], "t": ()}[kind]
+        items = {_unesc(tok.split(":", 1)[1]): convert(v)
+                 for tok, v in node.items()}
+        if kind == "d":
+            return items
+        seq = [items[str(i)] for i in range(len(items))]
+        return seq if kind == "l" else tuple(seq)
+
+    return convert(root)
+
+
+class Checkpoint:
+    """Handle to a checkpoint directory (reference: Checkpoint.from_directory)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    @staticmethod
+    def save(tree, path: str, metrics: Optional[dict] = None) -> "Checkpoint":
+        """Write pytree (host-gathered) atomically into `path`."""
+        import jax
+
+        tree = jax.device_get(tree)
+        flat = _flatten(tree)
+        arrays, scalars = {}, {}
+        for i, (k, v) in enumerate(flat.items()):
+            if isinstance(v, (np.ndarray, np.generic)):
+                arrays[f"a{i}"] = (k, np.asarray(v))
+            else:
+                scalars[k] = v
+        tmp = tempfile.mkdtemp(dir=os.path.dirname(path) or ".")
+        try:
+            np.savez(os.path.join(tmp, _ARRAYS_FILE),
+                     **{aid: arr for aid, (k, arr) in arrays.items()})
+            with open(os.path.join(tmp, _TREE_FILE), "w") as f:
+                json.dump({"keys": {aid: k for aid, (k, _) in arrays.items()},
+                           "scalars": scalars,
+                           "time": time.time()}, f)
+            if metrics is not None:
+                with open(os.path.join(tmp, _METRICS_FILE), "w") as f:
+                    json.dump(metrics, f)
+            # Swap with no window where `path` is absent: move the old dir
+            # aside first, replace, then clean up the aside copy.
+            aside = None
+            if os.path.exists(path):
+                aside = f"{path}.old.{os.getpid()}"
+                os.replace(path, aside)
+            os.replace(tmp, path)
+            if aside:
+                shutil.rmtree(aside, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return Checkpoint(path)
+
+    def load(self, shardings=None):
+        """Restore the pytree; optionally device_put with `shardings`
+        (a pytree of NamedSharding matching the saved structure — this is
+        how restore re-shards onto a new mesh)."""
+        with open(os.path.join(self.path, _TREE_FILE)) as f:
+            meta = json.load(f)
+        data = np.load(os.path.join(self.path, _ARRAYS_FILE))
+        flat = dict(meta["scalars"])
+        for aid, key in meta["keys"].items():
+            flat[key] = data[aid]
+        tree = _unflatten(flat)
+        if shardings is not None:
+            import jax
+            tree = jax.device_put(tree, shardings)
+        return tree
+
+    def metrics(self) -> dict:
+        p = os.path.join(self.path, _METRICS_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+
+class CheckpointManager:
+    """Rotating checkpoint dirs under a run's storage path
+    (reference: train/_internal/checkpoint_manager.py)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None):
+        self.root = root
+        self.num_to_keep = num_to_keep
+        os.makedirs(root, exist_ok=True)
+
+    def dir_for(self, step: int) -> str:
+        return os.path.join(self.root, f"checkpoint_{step:08d}")
+
+    def save(self, tree, step: int, metrics: Optional[dict] = None) -> Checkpoint:
+        ckpt = Checkpoint.save(tree, self.dir_for(step), metrics)
+        self._prune()
+        return ckpt
+
+    def latest(self) -> Optional[Checkpoint]:
+        cs = self._all()
+        return Checkpoint(cs[-1]) if cs else None
+
+    @staticmethod
+    def step_of(path: str) -> int:
+        """Parse the step number out of a checkpoint dir path."""
+        name = os.path.basename(path.rstrip("/"))
+        try:
+            return int(name.rsplit("_", 1)[-1])
+        except ValueError:
+            return 0
+
+    def _all(self):
+        return sorted(
+            os.path.join(self.root, d) for d in os.listdir(self.root)
+            if d.startswith("checkpoint_"))
+
+    def _prune(self):
+        if not self.num_to_keep:
+            return
+        for stale in self._all()[:-self.num_to_keep]:
+            shutil.rmtree(stale, ignore_errors=True)

@@ -1,0 +1,73 @@
+"""Run/Scaling/Failure/Checkpoint configs.
+
+Reference equivalents: python/ray/air/config.py (RunConfig/ScalingConfig/
+FailureConfig/CheckpointConfig) — reshaped for TPU: ScalingConfig speaks
+hosts × chips and a MeshSpec rather than num_workers × GPUs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How much hardware a run gets and how the mesh is laid over it.
+
+    num_workers: worker processes (1 per TPU host in production; local/test
+        runs use 1 worker driving the whole virtual mesh).
+    mesh: parallelism degrees laid over all chips across workers.
+    use_tpu: request TPU resources from the scheduler (False → CPU workers).
+    chips_per_worker: accelerator chips reserved per worker.
+    """
+
+    num_workers: int = 1
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Optional[dict] = None
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Reference: train/v2/.../failure_handling/failure_policy.py:14."""
+
+    max_failures: int = 0  # worker-group restarts before giving up
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Reference: air/config.py CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0  # steps between auto-checkpoints (0 = off)
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+    def resolve_storage(self) -> str:
+        base = self.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        name = self.name or "run"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path

@@ -1,0 +1,93 @@
+"""TrainController: the run state machine.
+
+Reference: python/ray/train/v2/_internal/execution/controller/controller.py
+:91 (states INITIALIZING→SCHEDULING→RUNNING→RESTARTING/…→FINISHED/ERRORED,
+run loop at :453), with FailurePolicy (failure_policy.py:14) deciding
+RETRY vs RAISE and restarts resuming from the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Callable, List, Optional
+
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+from ray_tpu.train.worker_group import WorkerGroup, WorkerGroupError
+
+logger = logging.getLogger(__name__)
+
+
+class ControllerState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
+class FailurePolicy:
+    """RETRY while failures remain within budget (reference semantics)."""
+
+    def __init__(self, cfg: FailureConfig):
+        self.cfg = cfg
+        self.failures = 0
+
+    def decide(self, error: WorkerGroupError) -> str:
+        self.failures += 1
+        if self.cfg.max_failures < 0:  # infinite retries
+            return "RETRY"
+        return "RETRY" if self.failures <= self.cfg.max_failures else "RAISE"
+
+
+class TrainController:
+    def __init__(self, train_fn: Callable, scaling: ScalingConfig,
+                 run_config: RunConfig,
+                 train_loop_config: Optional[dict] = None):
+        self.train_fn = train_fn
+        self.scaling = scaling
+        self.run_config = run_config
+        self.train_loop_config = train_loop_config
+        self.state = ControllerState.INITIALIZING
+        self.storage_path = run_config.resolve_storage()
+        self.ckpt_manager = CheckpointManager(
+            self.storage_path,
+            num_to_keep=run_config.checkpoint_config.num_to_keep)
+        self.failure_policy = FailurePolicy(run_config.failure_config)
+
+    def run(self) -> Result:
+        history: List[dict] = []
+        while True:
+            self.state = ControllerState.SCHEDULING
+            group = WorkerGroup(self.scaling.num_workers,
+                                self.scaling.worker_resources())
+            group.start()
+            try:
+                self.state = ControllerState.RUNNING
+                restore = self.ckpt_manager.latest()
+                per_worker = group.run(
+                    self.train_fn, self.storage_path,
+                    self.train_loop_config, restore,
+                    self.run_config.checkpoint_config.num_to_keep)
+                history.extend(per_worker[0])
+                self.state = ControllerState.FINISHED
+                return Result(
+                    metrics=per_worker[0][-1] if per_worker[0] else {},
+                    checkpoint=self.ckpt_manager.latest(),
+                    path=self.storage_path,
+                    metrics_history=history)
+            except WorkerGroupError as e:
+                decision = self.failure_policy.decide(e)
+                logger.warning("worker group failure #%d (%s): %s",
+                               self.failure_policy.failures, decision, e)
+                if decision == "RAISE":
+                    self.state = ControllerState.ERRORED
+                    return Result(metrics={}, checkpoint=self.ckpt_manager.latest(),
+                                  path=self.storage_path,
+                                  metrics_history=history, error=e)
+                self.state = ControllerState.RESTARTING
+            finally:
+                group.shutdown()

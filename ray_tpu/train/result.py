@@ -1,0 +1,18 @@
+"""Result of a training run (reference: python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    error: Optional[BaseException] = None

@@ -1,0 +1,71 @@
+"""Per-worker training session context.
+
+Reference: python/ray/train/_internal/session.py (report/get_context) and
+train/v2 session semantics: `report(metrics, checkpoint=...)` streams
+metrics to the controller and persists checkpoints rank-0-only.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+_local = threading.local()
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, storage_path: str,
+                 ckpt_manager: Optional[CheckpointManager] = None,
+                 restore_from: Optional[Checkpoint] = None,
+                 train_loop_config: Optional[dict] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.storage_path = storage_path
+        self.ckpt_manager = ckpt_manager
+        self.restore_from = restore_from
+        self.train_loop_config = train_loop_config or {}
+        self.reported: List[Dict[str, Any]] = []
+        self.step = 0
+
+    # -- API used inside train_loop_per_worker ------------------------------
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_rank(self) -> int:
+        return self.rank
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint_tree: Any = None) -> None:
+        """Record metrics; optionally snapshot a pytree checkpoint (rank 0)."""
+        self.step += 1
+        entry = dict(metrics)
+        entry["_step"] = self.step
+        if checkpoint_tree is not None and self.rank == 0 \
+                and self.ckpt_manager is not None:
+            ckpt = self.ckpt_manager.save(checkpoint_tree, self.step, metrics)
+            entry["_checkpoint_path"] = ckpt.path
+        self.reported.append(entry)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.restore_from
+
+
+def _set_context(ctx: Optional[TrainContext]) -> None:
+    _local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("not inside a ray_tpu.train worker loop")
+    return ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint_tree: Any = None) -> None:
+    get_context().report(metrics, checkpoint_tree)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().get_checkpoint()

@@ -1,0 +1,40 @@
+"""JaxTrainer — the user-facing trainer.
+
+Reference shape: python/ray/train/data_parallel_trainer.py:26 (v1 API) run
+on the v2 controller (SURVEY.md §3.4 recommends modeling on v2). The JAX
+backend needs no process-group plugin: ScalingConfig.mesh describes the
+whole-job device mesh and the train loop builds it via ray_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+from ray_tpu.train.result import Result
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        controller = TrainController(
+            self.train_loop_per_worker, self.scaling_config,
+            self.run_config, self.train_loop_config)
+        result = controller.run()
+        if result.error is not None:
+            raise TrainingFailedError(str(result.error)) from result.error
+        return result
+
+
+class TrainingFailedError(RuntimeError):
+    """Raised when the failure budget is exhausted (reference:
+    train/base_trainer.py TrainingFailedError)."""

@@ -1,0 +1,112 @@
+"""WorkerGroup: the actor fleet running train_loop_per_worker.
+
+Reference: python/ray/train/_internal/worker_group.py:102 (actor group with
+execute/execute_async) and train/v2 worker-group health polling. Workers are
+ray_tpu actors — one per TPU host in production, scheduled with TPU
+resources so the gang lands on one slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.session import TrainContext, _set_context
+
+
+class WorkerGroupError(RuntimeError):
+    def __init__(self, rank: int, cause: BaseException):
+        super().__init__(f"train worker {rank} failed: {cause!r}")
+        self.rank = rank
+        self.cause = cause
+
+
+class _TrainWorker:
+    """Actor body. Runs the user loop under a bound TrainContext."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+
+    def run(self, fn: Callable, storage_path: str,
+            train_loop_config: Optional[dict],
+            restore_path: Optional[str],
+            num_to_keep: Optional[int]) -> List[dict]:
+        ctx = TrainContext(
+            rank=self.rank, world_size=self.world_size,
+            storage_path=storage_path,
+            ckpt_manager=CheckpointManager(
+                storage_path, num_to_keep=num_to_keep),
+            restore_from=(Checkpoint(restore_path) if restore_path else None),
+            train_loop_config=train_loop_config)
+        if restore_path:
+            # Continue the step numbering of the restored run so restart
+            # checkpoints never collide with (or sort below) earlier ones.
+            ctx.step = CheckpointManager.step_of(restore_path)
+        _set_context(ctx)
+        try:
+            fn(dict(ctx.train_loop_config)) if _wants_arg(fn) else fn()
+            return ctx.reported
+        finally:
+            _set_context(None)
+
+    def health_check(self) -> bool:
+        return True
+
+
+def _wants_arg(fn: Callable) -> bool:
+    import inspect
+    try:
+        return len(inspect.signature(fn).parameters) >= 1
+    except (TypeError, ValueError):
+        return False
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: dict):
+        self.num_workers = num_workers
+        self.resources = resources_per_worker
+        self.workers: List[Any] = []
+
+    def start(self) -> None:
+        cls = ray_tpu.remote(**{
+            "num_cpus": self.resources.get("CPU", 1.0),
+            "resources": {k: v for k, v in self.resources.items()
+                          if k != "CPU"} or None,
+        })(_TrainWorker)
+        self.workers = [cls.remote(rank, self.num_workers)
+                        for rank in range(self.num_workers)]
+
+    def run(self, fn: Callable, storage_path: str,
+            train_loop_config: Optional[dict],
+            restore: Optional[Checkpoint],
+            num_to_keep: Optional[int]) -> List[List[dict]]:
+        """Execute the loop on every worker; raise WorkerGroupError on the
+        first failure (reference: backend_executor re-raises worker errors)."""
+        refs = [w.run.remote(fn, storage_path, train_loop_config,
+                             restore.path if restore else None, num_to_keep)
+                for w in self.workers]
+        # Await completions in ARRIVAL order, not rank order: a crash on
+        # rank>0 must surface even while rank 0 blocks in a collective
+        # (reference: backend_executor polls all workers, not worker 0).
+        rank_of = {ref: rank for rank, ref in enumerate(refs)}
+        results: List[Any] = [None] * len(refs)
+        pending = list(refs)
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:
+                rank = rank_of[ref]
+                try:
+                    results[rank] = ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001 — worker fault boundary
+                    raise WorkerGroupError(rank, e) from e
+        return results
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self.workers = []

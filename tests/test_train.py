@@ -1,0 +1,157 @@
+"""JaxTrainer / checkpoint / controller tests (local mode + CPU mesh)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_loss
+from ray_tpu.models import llama
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.train import (CheckpointConfig, Checkpoint, FailureConfig,
+                           JaxTrainer, RunConfig, ScalingConfig,
+                           make_train_step, shard_params)
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.trainer import TrainingFailedError
+
+
+class TestCheckpoint:
+    def test_roundtrip_nested_pytree(self, tmp_path):
+        tree = {"a": np.arange(6).reshape(2, 3),
+                "b": [np.ones(4), {"c": np.float32(2.5)}],
+                "d": (np.zeros(2), 7.0),
+                "e": "hello"}
+        ckpt = Checkpoint.save(tree, str(tmp_path / "ck"))
+        back = ckpt.load()
+        assert np.array_equal(back["a"], tree["a"])
+        assert np.array_equal(back["b"][0], tree["b"][0])
+        assert float(back["b"][1]["c"]) == 2.5
+        assert isinstance(back["d"], tuple)
+        assert back["e"] == "hello"
+
+    def test_roundtrip_edge_pytrees(self, tmp_path):
+        # keys with separators (haiku-style), empty containers, bare leaf
+        tree = {"mlp/~/linear_0": {"w": np.ones(2)}, "empty": {},
+                "elist": [], "etup": ()}
+        back = Checkpoint.save(tree, str(tmp_path / "c1")).load()
+        assert np.array_equal(back["mlp/~/linear_0"]["w"], np.ones(2))
+        assert back["empty"] == {} and back["elist"] == [] \
+            and back["etup"] == ()
+        bare = Checkpoint.save(np.arange(3), str(tmp_path / "c2")).load()
+        assert np.array_equal(bare, np.arange(3))
+
+    def test_manager_rotation(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), num_to_keep=2)
+        for step in range(5):
+            mgr.save({"x": np.array([step])}, step)
+        dirs = sorted(os.listdir(tmp_path))
+        assert len(dirs) == 2
+        assert mgr.latest().load()["x"][0] == 4
+
+    def test_restore_onto_mesh(self, tmp_path):
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        tree = {"w": np.arange(32.0).reshape(8, 4)}
+        ckpt = Checkpoint.save(tree, str(tmp_path / "ck"))
+        shardings = {"w": NamedSharding(mesh, P("fsdp", "tp"))}
+        back = ckpt.load(shardings=shardings)
+        assert back["w"].sharding == shardings["w"]
+        assert np.array_equal(np.asarray(back["w"]), tree["w"])
+
+
+def _mlp_loop(config):
+    from ray_tpu import train as rt_train
+    ctx = rt_train.get_context()
+    cfg = MLPConfig(in_dim=16, hidden=32, out_dim=4)
+    params = mlp_init(cfg, jax.random.PRNGKey(0))
+    start = 0
+    if ctx.get_checkpoint() is not None:
+        state = ctx.get_checkpoint().load()
+        params, start = state["params"], int(state["step"])
+    init_fn, step_fn = make_train_step(mlp_loss, optax.adam(1e-2))
+    opt_state = init_fn(params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+    y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 4)
+    for step in range(start, config["steps"]):
+        params, opt_state, metrics = step_fn(params, opt_state, (x, y))
+        if config.get("fail_at") is not None and step == config["fail_at"] \
+                and not os.path.exists(config["fail_marker"]):
+            open(config["fail_marker"], "w").close()
+            raise RuntimeError("injected worker failure")
+        rt_train.report({"loss": float(metrics["loss"]), "step": step},
+                        checkpoint_tree={"params": params, "step": step + 1})
+
+
+class TestJaxTrainer:
+    def test_mlp_end_to_end(self, rtpu_local, tmp_path):
+        trainer = JaxTrainer(
+            _mlp_loop,
+            train_loop_config={"steps": 5, "fail_at": None},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="mlp", storage_path=str(tmp_path)))
+        result = trainer.fit()
+        assert result.metrics["step"] == 4
+        assert len(result.metrics_history) == 5
+        losses = [m["loss"] for m in result.metrics_history]
+        assert losses[-1] < losses[0]
+        assert result.checkpoint is not None
+        assert int(result.checkpoint.load()["step"]) == 5
+
+    def test_failure_restart_resumes_from_checkpoint(self, rtpu_local,
+                                                     tmp_path):
+        marker = str(tmp_path / "failed_once")
+        trainer = JaxTrainer(
+            _mlp_loop,
+            train_loop_config={"steps": 6, "fail_at": 3,
+                               "fail_marker": marker},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="mlp_ft", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1)))
+        result = trainer.fit()
+        assert os.path.exists(marker)  # the failure really happened
+        # resumed from step 3 (checkpoint written at step 2 → start=3)
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps[-1] == 5
+        assert result.checkpoint is not None
+        # restart checkpoints continue the numbering — latest() is the
+        # newest state, not a stale pre-failure dir
+        from ray_tpu.train.checkpoint import CheckpointManager as CM
+        assert CM.step_of(result.checkpoint.path) >= 6
+
+    def test_failure_budget_exhausted_raises(self, rtpu_local, tmp_path):
+        def always_fail(config):
+            raise RuntimeError("boom")
+
+        trainer = JaxTrainer(
+            always_fail,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="f", storage_path=str(tmp_path),
+                                 failure_config=FailureConfig(max_failures=1)))
+        with pytest.raises(TrainingFailedError):
+            trainer.fit()
+
+
+class TestShardedTrainStep:
+    def test_llama_fsdp_tp_step(self):
+        mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+        params = shard_params(llama.init_params(cfg, jax.random.PRNGKey(0)),
+                              mesh, llama.param_specs(cfg))
+        init_fn, step_fn = make_train_step(
+            lambda p, b: llama.loss_fn(p, b, cfg), optax.adamw(1e-3))
+        opt_state = init_fn(params)
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                               cfg.vocab_size),
+            NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        losses = []
+        for _ in range(3):
+            params, opt_state, m = step_fn(params, opt_state, tokens)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(losses))
