@@ -45,10 +45,11 @@ class LlamaConfig:
     ffn_dim: int = 14336
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
-    attention: str = "full"          # full | ring | ulysses
+    attention: str = "full"          # full | flash | ring | ulysses
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32   # master weights
     remat: bool = True
+    remat_policy: str = "full"       # full | dots | dots_no_batch
     pp_microbatches: int = 4         # microbatch count when pp > 1
 
     @property
@@ -179,7 +180,16 @@ def _scan_layers(layers: Params, x, cfg: LlamaConfig, positions, attn_fn):
     body = functools.partial(_layer, cfg=cfg, positions=positions,
                              attn_fn=attn_fn)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        # "dots" keeps matmul outputs and recomputes only cheap elementwise
+        # ops in backward — much less recompute FLOP than full remat at a
+        # modest memory cost (HBM-bandwidth-friendly default on TPU).
+        policy = {
+            "full": None,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+            "dots_no_batch":
+                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        }[cfg.remat_policy]
+        body = jax.checkpoint(body, policy=policy)
 
     def step(x, lp):
         return body(lp, x), None
@@ -209,14 +219,27 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
         x = _scan_layers(params["layers"], x, cfg, positions, attn_fn)
 
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    # Tied embeddings: logits = x · embedᵀ, fp32 accumulation on the MXU.
-    return jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
-                      params["embed"].astype(jnp.float32))
+    # Tied embeddings: logits = x · embedᵀ. bf16 operands on the MXU with
+    # fp32 ACCUMULATION (f32 operands would leave the MXU fast path).
+    return jnp.einsum("bld,vd->blv", x.astype(cd),
+                      params["embed"].astype(cd),
+                      preferred_element_type=jnp.float32)
 
 
 def _make_attn_fn(cfg: LlamaConfig, mesh):
     if cfg.attention == "full":
         return _full_attention
+    if cfg.attention == "flash":
+        from ray_tpu.ops import flash_attention
+        from ray_tpu.ops.flash_attention import (blockwise_attention,
+                                                 flash_attention_sharded,
+                                                 kernels_supported)
+        if not kernels_supported():
+            # Portable fallback (CPU test meshes): same blockwise numerics.
+            return lambda q, k, v: blockwise_attention(q, k, v).astype(q.dtype)
+        if mesh is not None:
+            return functools.partial(flash_attention_sharded, mesh=mesh)
+        return flash_attention
     if mesh is None:
         raise ValueError(f"attention={cfg.attention!r} needs a mesh")
     if cfg.attention == "ring":

@@ -1,0 +1,15 @@
+"""ray_tpu.ops — Pallas TPU kernels for the hot ops.
+
+The reference's hot ops live in external CUDA (vLLM paged attention, NCCL);
+here they are Pallas kernels compiled for the MXU/VMEM hierarchy:
+flash attention (training), with blockwise-JAX fallbacks that run anywhere
+(CPU mesh tests, interpret mode).
+"""
+
+from ray_tpu.ops.flash_attention import (blockwise_attention,
+                                         flash_attention,
+                                         flash_attention_sharded,
+                                         kernels_supported)
+
+__all__ = ["flash_attention", "flash_attention_sharded",
+           "blockwise_attention", "kernels_supported"]

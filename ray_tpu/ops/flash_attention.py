@@ -1,0 +1,378 @@
+"""Flash attention: Pallas forward AND backward kernels.
+
+No reference implementation exists in-tree (the reference delegates to
+vLLM/CUDA — SURVEY.md §5 long-context); built from the public flash/
+blockwise-attention recipes (PAPERS.md) on the Pallas TPU pattern:
+stream KV blocks through VMEM with online-softmax accumulators in scratch,
+never materializing the [L, L] score matrix in HBM — in either pass.
+
+  flash_attention(q, k, v)  [B, L, H, D] → [B, L, H, D]
+    fwd:  grid (B·H, Lq/blkq, Lk/blkk); saves per-row logsumexp.
+    bwd:  two kernels — dq over (B·H, nq, nk) and dk/dv over (B·H, nk, nq)
+          — recompute p = exp(s − lse) blockwise from the saved lse.
+    causal blocks above the diagonal are skipped in all three kernels.
+
+`blockwise_attention` is the pure-JAX (lax.scan) equivalent: same online
+softmax, differentiable by autodiff, used as the numerics reference and as
+a portable fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_NEG_INF = float("-inf")
+
+
+# --------------------------------------------------------------------------
+# Blockwise attention in pure JAX (reference numerics + portable fallback)
+# --------------------------------------------------------------------------
+
+def blockwise_attention(q, k, v, *, causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        block_k: int = 256) -> jax.Array:
+    """Online-softmax attention, scanning KV blocks; [B, L, H, D] layout."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    blk = min(block_k, Lk)
+    if Lk % blk:
+        raise ValueError(f"seq len {Lk} not divisible by block_k {blk}")
+    nk = Lk // blk
+    kb = k.reshape(B, nk, blk, H, D)
+    vb = v.reshape(B, nk, blk, H, D)
+    qpos = jnp.arange(Lq)
+    qs = q * q.dtype.type(sm_scale)
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+
+    def step(carry, blk_idx):
+        o, m, l = carry
+        kt, vt = kb[:, blk_idx], vb[:, blk_idx]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qs, kt,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            kpos = blk_idx * blk + jnp.arange(blk)
+            s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
+                          s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_new[..., None]))
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_new))
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(vt.dtype), vt,
+            preferred_element_type=jnp.float32)
+        return (o, m_new, l), None
+
+    (o, m, l), _ = lax.scan(step, (o0, m0, l0), jnp.arange(nk))
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels ([BH, L, D] layout inside)
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal, sm_scale, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        run = ki * blk_k <= qi * blk_q + blk_q - 1
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]
+        s = lax.dot_general(  # bf16×bf16 → f32 accumulate on the MXU
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * blk_q + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            kpos = ki * blk_k + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:, :1], l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_new))
+        corr = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_new))
+        l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(l[:, 0])
+        # lse is materialized [8, blk_q] (sublane-replicated) to satisfy
+        # the TPU (8, 128) tiling floor for output blocks.
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, causal, sm_scale, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ki * blk_k <= qi * blk_q + blk_q - 1
+
+    @pl.when(run)
+    def _block():
+        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * blk_q + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            kpos = ki * blk_k + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])     # masked rows → exp(-inf)=0
+        dp = lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dq_acc[:] += lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                causal, sm_scale, blk_q, blk_k):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = qi * blk_q + blk_q - 1 >= ki * blk_k
+
+    @pl.when(run)
+    def _block():
+        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * blk_q + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            kpos = ki * blk_k + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        dv_acc[:] += lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dk_acc[:] += lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------------
+# pallas_call wrappers
+# --------------------------------------------------------------------------
+
+def _fwd_call(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    blk_q, blk_k = min(blk_q, Lq), min(blk_k, Lk)
+    if Lq % blk_q or Lk % blk_k:
+        raise ValueError(f"L ({Lq},{Lk}) must divide blocks ({blk_q},{blk_k})")
+    kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
+                               blk_q=blk_q, blk_k=blk_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, Lq // blk_q, Lk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, Lq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, D), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, blk_q, blk_k,
+              interpret):
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    blk_q, blk_k = min(blk_q, Lq), min(blk_k, Lk)
+    delta = jnp.einsum("bld,bld->bl", do.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[:, None, :], (BH, 8, Lq))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(BH, Lq // blk_q, Lk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(BH, Lk // blk_k, Lq // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Lk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, D), jnp.float32),
+            pltpu.VMEM((blk_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# Public API with custom VJP
+# --------------------------------------------------------------------------
+
+def _bhl(x):
+    B, L, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+
+def _blhd(x, B, H):
+    BH, L, D = x.shape
+    return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    blk_q: int = 256, blk_k: int = 256,
+                    interpret: bool = False) -> jax.Array:
+    """[B, L, H, D] flash attention; Pallas fwd+bwd, O(L·blk) memory."""
+    out, _ = _vjp_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret)
+    return out
+
+
+def _vjp_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
+    B, Lq, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    o, lse = _fwd_call(_bhl(q), _bhl(k), _bhl(v), causal, scale,
+                       blk_q, blk_k, interpret)
+    return _blhd(o, B, H), (q, k, v, o, lse)
+
+
+def _vjp_bwd(causal, sm_scale, blk_q, blk_k, interpret, res, g):
+    q, k, v, o, lse = res
+    B, Lq, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    dq, dk, dv = _bwd_call(_bhl(q), _bhl(k), _bhl(v), o, lse, _bhl(g),
+                           causal, scale, blk_q, blk_k, interpret)
+    return _blhd(dq, B, H), _blhd(dk, B, H), _blhd(dv, B, H)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def kernels_supported() -> bool:
+    """True when the Mosaic TPU kernels can actually lower here."""
+    if not _HAS_PALLAS:
+        return False
+    dev = jax.devices()[0]
+    return dev.platform == "tpu" or getattr(dev, "device_kind",
+                                            "").startswith("TPU")
+
+
+def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
+                            head_axis: str = "tp",
+                            batch_axes=("dp", "fsdp")) -> jax.Array:
+    """shard_map wrapper: pallas_call is a Mosaic custom call that GSPMD
+    cannot auto-partition, so run the kernel per-shard (batch over dp/fsdp,
+    heads over tp; seq must NOT be sharded — use ring attention for sp)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_compat
+
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("flash_attention_sharded cannot shard the sequence "
+                         "axis; use attention='ring' when sp > 1")
+    spec = P(batch_axes, None, head_axis, None)
+    fn = shard_map_compat(
+        functools.partial(flash_attention, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

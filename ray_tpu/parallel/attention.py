@@ -13,13 +13,19 @@ import jax.numpy as jnp
 
 
 def causal_attention(q, k, v, sm_scale: Optional[float] = None) -> jax.Array:
-    """q/k/v: [B, L, H, D] → [B, L, H, D] fp32; fp32 scores/softmax."""
+    """q/k/v: [B, L, H, D] → [B, L, H, D] fp32.
+
+    Matmuls keep the input dtype (bf16 on the MXU) with fp32 ACCUMULATION
+    via preferred_element_type — f32 operands would fall off the MXU fast
+    path on TPU; the softmax itself runs in fp32.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk",
-                   q.astype(jnp.float32) * sm_scale, k.astype(jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q * q.dtype.type(sm_scale), k,
+                   preferred_element_type=jnp.float32)
     Lq, Lk = q.shape[1], k.shape[1]
     mask = jnp.tril(jnp.ones((Lq, Lk), bool))
     s = jnp.where(mask[None, None], s, float("-inf"))
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)

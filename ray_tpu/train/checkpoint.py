@@ -44,7 +44,9 @@ def _flatten(tree, prefix=""):
         for i, v in enumerate(tree):
             out.update(_flatten(v, f"{prefix}{tag}:{i}/"))
     elif isinstance(tree, (dict, list, tuple)):  # empty container leaf
-        out[prefix + {dict: "d", list: "l", tuple: "t"}[type(tree)] + ":<empty>"] = None
+        kind = "d" if isinstance(tree, dict) else (
+            "l" if isinstance(tree, list) else "t")
+        out[f"{prefix}{kind}:<empty>"] = None
     else:
         out[prefix.rstrip("/")] = tree
     return out
@@ -119,11 +121,15 @@ class Checkpoint:
             if metrics is not None:
                 with open(os.path.join(tmp, _METRICS_FILE), "w") as f:
                     json.dump(metrics, f)
-            # Swap with no window where `path` is absent: move the old dir
-            # aside first, replace, then clean up the aside copy.
+            # Two-rename swap: move the old dir to a dot-prefixed name
+            # (invisible to CheckpointManager's checkpoint_* listing) and
+            # rename the tmp dir in. A crash mid-swap leaves either the old
+            # or the new data discoverable — never a half-written dir.
             aside = None
             if os.path.exists(path):
-                aside = f"{path}.old.{os.getpid()}"
+                aside = os.path.join(
+                    os.path.dirname(path) or ".",
+                    f".removing.{os.path.basename(path)}.{os.getpid()}")
                 os.replace(path, aside)
             os.replace(tmp, path)
             if aside:
@@ -133,10 +139,16 @@ class Checkpoint:
             raise
         return Checkpoint(path)
 
-    def load(self, shardings=None):
-        """Restore the pytree; optionally device_put with `shardings`
-        (a pytree of NamedSharding matching the saved structure — this is
-        how restore re-shards onto a new mesh)."""
+    def load(self, shardings=None, target=None):
+        """Restore the pytree.
+
+        shardings: optional pytree of NamedSharding — device_put on load;
+            this is how restore re-shards onto a NEW mesh (elastic recovery).
+        target: optional template pytree. Saved trees normalize containers
+            (namedtuples → tuples, keys → str); passing the live structure
+            (e.g. a freshly-built optax opt_state) restores the leaves INTO
+            that structure, the orbax restore(item=...) pattern.
+        """
         with open(os.path.join(self.path, _TREE_FILE)) as f:
             meta = json.load(f)
         data = np.load(os.path.join(self.path, _ARRAYS_FILE))
@@ -144,6 +156,15 @@ class Checkpoint:
         for aid, key in meta["keys"].items():
             flat[key] = data[aid]
         tree = _unflatten(flat)
+        if target is not None:
+            import jax
+            leaves = jax.tree.leaves(tree)
+            structure = jax.tree.structure(target)
+            if structure.num_leaves != len(leaves):
+                raise ValueError(
+                    f"target structure has {structure.num_leaves} leaves, "
+                    f"checkpoint has {len(leaves)}")
+            tree = jax.tree.unflatten(structure, leaves)
         if shardings is not None:
             import jax
             tree = jax.device_put(tree, shardings)

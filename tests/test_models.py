@@ -107,6 +107,22 @@ class TestLlamaSharded:
         np.testing.assert_allclose(np.asarray(uly), np.asarray(full),
                                    rtol=2e-3, atol=2e-3)
 
+    def test_flash_falls_back_on_cpu_mesh(self):
+        # attention='flash' on a CPU mesh routes to the blockwise fallback
+        # (Mosaic kernels only lower on real TPU) and matches full attention.
+        mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        cfg_full = llama.LlamaConfig.tiny(dtype=jnp.float32, n_layers=2)
+        cfg_fl = llama.LlamaConfig.tiny(dtype=jnp.float32, n_layers=2,
+                                        attention="flash")
+        params = llama.init_params(cfg_full, jax.random.PRNGKey(0))
+        tokens = make_inputs(cfg_full, B=4, L=32)
+        full = jax.jit(functools.partial(llama.forward, cfg=cfg_full))(
+            params, tokens)
+        fl = jax.jit(functools.partial(llama.forward, cfg=cfg_fl,
+                                       mesh=mesh))(params, tokens)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(full),
+                                   rtol=2e-3, atol=2e-3)
+
     def test_ring_loss_with_pow2_seq(self):
         # loss_fn must keep the full (sp-divisible) seq through forward.
         mesh = build_mesh(MeshSpec(sp=4, tp=2))

@@ -1,0 +1,103 @@
+"""Pallas kernel tests — run in interpret mode on the CPU mesh
+(the kernels themselves are exercised on real TPU by bench.py)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import blockwise_attention, flash_attention
+from ray_tpu.parallel.attention import causal_attention
+
+
+def make_qkv(B=2, L=256, H=4, D=32, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, L, H, D), dtype) for k in ks)
+
+
+class TestBlockwiseAttention:
+    def test_matches_naive(self):
+        q, k, v = make_qkv()
+        ref = causal_attention(q, k, v).astype(jnp.float32)
+        got = blockwise_attention(q, k, v, block_k=64).astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grads_match_naive(self):
+        q, k, v = make_qkv(L=128)
+
+        def loss_ref(q, k, v):
+            return (causal_attention(q, k, v) ** 2).sum()
+
+        def loss_blk(q, k, v):
+            return (blockwise_attention(q, k, v, block_k=32)
+                    .astype(jnp.float32) ** 2).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gb):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-3, atol=1e-3)
+
+
+class TestFlashAttention:
+    """interpret=True executes the actual kernel logic on CPU."""
+
+    def test_fwd_matches_naive(self):
+        q, k, v = make_qkv(L=256)
+        ref = causal_attention(q, k, v).astype(jnp.float32)
+        got = flash_attention(q, k, v, True, None, 128, 128, True)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_bwd_matches_naive(self):
+        q, k, v = make_qkv(L=128, H=2)
+
+        def loss_ref(q, k, v):
+            return (causal_attention(q, k, v) ** 2).sum()
+
+        def loss_fl(q, k, v):
+            return (flash_attention(q, k, v, True, None, 64, 64, True)
+                    .astype(jnp.float32) ** 2).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gr, gf):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-3,
+                err_msg=f"d{name} mismatch")
+
+    def test_noncausal(self):
+        q, k, v = make_qkv(L=128)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q * q.shape[-1] ** -0.5, k)
+        p = jax.nn.softmax(s, axis=-1)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        got = flash_attention(q, k, v, False, None, 64, 64, True)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_llama_flash_matches_full(self):
+        from ray_tpu.models import llama
+        cfg_full = llama.LlamaConfig.tiny(dtype=jnp.float32, n_layers=2)
+        params = llama.init_params(cfg_full, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                    cfg_full.vocab_size)
+        full = llama.forward(params, tokens, cfg_full)
+        # route through the blockwise fallback semantics via flash interpret
+        import ray_tpu.ops as ops
+        orig = ops.flash_attention
+        try:
+            def interp_flash(q, k, v, *a, **kw):
+                return orig(q, k, v, True, None, 16, 16, True)
+            ops.flash_attention = interp_flash
+            cfg_fl = llama.LlamaConfig.tiny(dtype=jnp.float32, n_layers=2,
+                                            attention="flash")
+            fl = llama.forward(params, tokens, cfg_fl)
+        finally:
+            ops.flash_attention = orig
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(full),
+                                   rtol=2e-3, atol=2e-3)

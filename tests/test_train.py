@@ -45,6 +45,27 @@ class TestCheckpoint:
         bare = Checkpoint.save(np.arange(3), str(tmp_path / "c2")).load()
         assert np.array_equal(bare, np.arange(3))
 
+    def test_load_into_target_structure(self, tmp_path):
+        # namedtuple pytrees (optax states) normalize to tuples on save;
+        # target= restores leaves into the live structure (orbax pattern).
+        import optax
+        cfg = MLPConfig(in_dim=8, hidden=8, out_dim=2)
+        params = mlp_init(cfg, jax.random.PRNGKey(0))
+        opt = optax.adam(1e-3)
+        state = opt.init(params)
+        ckpt = Checkpoint.save({"opt": state}, str(tmp_path / "ck"))
+        template = {"opt": opt.init(params)}
+        back = ckpt.load(target=template)["opt"]
+        assert type(back) is type(state)
+        chex = jax.tree.map(np.allclose, jax.tree.leaves(back),
+                            jax.tree.leaves(state))
+        assert all(jax.tree.leaves(chex))
+        # the jitted step accepts the restored state
+        _, g = jax.value_and_grad(mlp_loss)(
+            params, (np.ones((4, 8), np.float32),
+                     np.zeros((4,), np.int64)))
+        opt.update(g, back, params)
+
     def test_manager_rotation(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path), num_to_keep=2)
         for step in range(5):
