@@ -66,7 +66,11 @@ class ActorClass:
             cls=self._cls,
             args=worker.make_task_args(args),
             kwargs=dict(kwargs),
-            resources=_build_resources(opts) or {"CPU": 1.0},
+            # Reference semantics (python/ray/actor.py defaults): an actor
+            # holds 0 CPUs for its lifetime unless resources are requested
+            # explicitly — idle actors don't block scheduling (this is what
+            # makes 40k actors/cluster possible in the baseline).
+            resources=_build_resources(opts),
             max_restarts=int(opts.get("max_restarts", 0)),
             max_task_retries=int(opts.get("max_task_retries", 0)),
             max_concurrency=int(opts.get("max_concurrency", 1)),

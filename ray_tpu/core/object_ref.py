@@ -75,7 +75,13 @@ class ObjectRef:
 
 
 def _deserialize_ref(id_binary: bytes, owner_binary: bytes) -> "ObjectRef":
-    return ObjectRef(ObjectID(id_binary), WorkerID(owner_binary))
+    ref = ObjectRef(ObjectID(id_binary), WorkerID(owner_binary))
+    # Receiving a ref from another process makes this process a borrower;
+    # cluster mode wires this to an add_borrower RPC to the owner
+    # (reference: ReferenceCounter borrower registration,
+    # src/ray/core_worker/reference_count.h:66).
+    _deserialized_hook(ref)
+    return ref
 
 
 # Indirection so ObjectRef stays importable before a worker exists; the worker
@@ -87,13 +93,16 @@ def _noop(_id):
 _refcounter_add = _noop
 _refcounter_remove = _noop
 _refcounter_borrow = _noop
+_deserialized_hook = _noop
 
 
-def install_refcount_hooks(add, remove, borrow) -> None:
+def install_refcount_hooks(add, remove, borrow, deserialized=None) -> None:
     global _refcounter_add, _refcounter_remove, _refcounter_borrow
+    global _deserialized_hook
     _refcounter_add = add
     _refcounter_remove = remove
     _refcounter_borrow = borrow
+    _deserialized_hook = deserialized or _noop
 
 
 def _get_refcounter_add():

@@ -148,6 +148,10 @@ class ReferenceCounter:
                 self._counts[object_id] = c
             c.owned = True
 
+    def is_tracked(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._counts
+
     def num_tracked(self) -> int:
         with self._lock:
             return len(self._counts)

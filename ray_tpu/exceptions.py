@@ -37,6 +37,11 @@ class TaskError(RayTpuError):
             cause=exc,
         )
 
+    def __reduce__(self):
+        # cause may be unpicklable (it crossed a process already); drop it.
+        return (TaskError,
+                (self.cause_cls_name, self.cause_repr, self.remote_tb))
+
 
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
@@ -54,6 +59,9 @@ class ActorDiedError(ActorError):
         self.reason = reason
         super().__init__(f"actor {actor_id_hex} died: {reason}")
 
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id_hex, self.reason))
+
 
 class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (restarting); call may be retried."""
@@ -64,7 +72,11 @@ class ObjectLostError(RayTpuError):
 
     def __init__(self, object_id_hex: str = "", reason: str = ""):
         self.object_id_hex = object_id_hex
+        self.reason = reason
         super().__init__(f"object {object_id_hex} lost: {reason}")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id_hex, self.reason))
 
 
 class ObjectStoreFullError(RayTpuError):
@@ -85,7 +97,11 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 class TaskCancelledError(RayTpuError):
     def __init__(self, task_id_hex: str = ""):
+        self.task_id_hex = task_id_hex
         super().__init__(f"task {task_id_hex} was cancelled")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id_hex,))
 
 
 class RuntimeEnvSetupError(RayTpuError):

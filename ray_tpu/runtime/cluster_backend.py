@@ -1,0 +1,819 @@
+"""Cluster backend — the client-side transport for the multiprocess runtime.
+
+Role-equivalent to the reference's owner-side CoreWorker submission machinery
+(reference: src/ray/core_worker/core_worker.cc:2476 SubmitTask, :2557
+CreateActor, :2804 SubmitActorTask) with its two transports:
+
+ - _TaskSubmitter: lease-based pipelined submission for normal tasks
+   (reference: transport/normal_task_submitter.h:74) — leases are requested
+   from the head, cached while the same resource shape has pending work
+   (the lease-reuse trick that makes reference task throughput possible),
+   and tasks are pushed directly to the leased worker.
+ - _ActorSubmitter: direct worker-to-worker pushes with per-handle ordering
+   and restart-aware address re-resolution (reference:
+   transport/actor_task_submitter.h:75).
+
+`connect_or_start` is the process-supervision role of the reference's Node
+(reference: python/ray/_private/node.py:1189 start_gcs_server, :1223
+start_raylet): it boots the head and a node daemon as subprocesses when no
+address is given.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import config as config_mod
+from ray_tpu.core import serialization
+from ray_tpu.core._native import ShmStore
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, JobID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
+from ray_tpu.exceptions import (ActorDiedError, PlacementGroupUnschedulableError,
+                                TaskCancelledError, TaskError,
+                                WorkerCrashedError)
+from ray_tpu.runtime import wire
+from ray_tpu.runtime.object_plane import ObjectPlane
+from ray_tpu.runtime.spawn import child_env as _child_env
+from ray_tpu.runtime.protocol import (ClientPool, RpcClient, RpcError,
+                                      RpcServer)
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker_addr", "worker_id", "busy", "idle_since")
+
+    def __init__(self, lease_id: str, worker_addr: str, worker_id: bytes):
+        self.lease_id = lease_id
+        self.worker_addr = worker_addr
+        self.worker_id = worker_id
+        self.busy = False
+        self.idle_since = time.monotonic()
+
+
+class _PendingTask:
+    __slots__ = ("payload", "spec", "pins", "attempts")
+
+    def __init__(self, payload: dict, spec: TaskSpec, pins: list):
+        self.payload = payload
+        self.spec = spec
+        self.pins = pins          # ObjectIDs pinned until reply
+        self.attempts = 0
+
+
+class _TaskSubmitter:
+    """Lease-cached pipelined submission for one resource shape."""
+
+    def __init__(self, backend: "ClusterBackend", shape_key: tuple,
+                 resources: Dict[str, float]):
+        self.backend = backend
+        self.shape_key = shape_key
+        self.resources = resources
+        self.pending: collections.deque = collections.deque()
+        self.leases: Dict[str, _Lease] = {}
+        self.requesting = 0
+        self.lock = threading.Lock()
+
+    # -- public --
+
+    def submit(self, payload: dict, spec: TaskSpec, pins: list) -> None:
+        with self.lock:
+            self.pending.append(_PendingTask(payload, spec, pins))
+        self._pump()
+
+    def cancel(self, task_id: bytes) -> bool:
+        with self.lock:
+            for t in list(self.pending):
+                if t.payload["task_id"] == task_id:
+                    self.pending.remove(t)
+                    self.backend._store_task_error(
+                        t.spec, TaskCancelledError(task_id.hex()), t.pins)
+                    return True
+        for lease in list(self.leases.values()):
+            try:
+                self.backend.peers.get(lease.worker_addr).call(
+                    "cancel_task", {"task_id": task_id}, timeout=5.0)
+            except RpcError:
+                pass
+        return False
+
+    # -- internals --
+
+    def _pump(self) -> None:
+        """Assign pending tasks to idle leases; request more leases if short."""
+        while True:
+            with self.lock:
+                if not self.pending:
+                    return
+                lease = next((l for l in self.leases.values() if not l.busy),
+                             None)
+                if lease is None:
+                    need_more = (len(self.pending) >
+                                 self.requesting) and not self.backend._closed
+                    if need_more:
+                        self.requesting += 1
+                    break
+                task = self.pending.popleft()
+                lease.busy = True
+            self._push(lease, task)
+        if need_more:
+            threading.Thread(target=self._request_lease, daemon=True,
+                             name="lease-req").start()
+
+    def _request_lease(self) -> None:
+        try:
+            while not self.backend._closed:
+                with self.lock:
+                    if not self.pending:
+                        return
+                try:
+                    grant = self.backend.head.call_retrying(
+                        "request_lease", {"resources": self.resources})
+                except RpcError:
+                    time.sleep(0.2)
+                    continue
+                if grant.get("infeasible"):
+                    self._fail_pending(TaskError(
+                        "PlacementError",
+                        f"no node can satisfy resources {self.resources}",
+                        "<scheduler>"))
+                    return
+                if grant.get("retry"):
+                    time.sleep(0.05)
+                    continue
+                lease = _Lease(grant["lease_id"], grant["worker_addr"],
+                               grant["worker_id"])
+                with self.lock:
+                    self.leases[lease.lease_id] = lease
+                break
+        finally:
+            with self.lock:
+                self.requesting = max(0, self.requesting - 1)
+            # Always re-pump: a task may have been enqueued in the window
+            # where this thread still counted toward `requesting` but was
+            # about to exit (e.g. the early return on empty pending).
+            self._pump()
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self.lock:
+            tasks = list(self.pending)
+            self.pending.clear()
+        for t in tasks:
+            self.backend._store_task_error(t.spec, exc, t.pins)
+
+    def _push(self, lease: _Lease, task: _PendingTask) -> None:
+        task.attempts += 1
+        client = self.backend.peers.get(lease.worker_addr)
+        fut = client.call_async("push_task", task.payload)
+        fut.add_done_callback(
+            lambda f: self._on_reply(lease, task, f))
+
+    def _on_reply(self, lease: _Lease, task: _PendingTask, fut) -> None:
+        exc = fut.exception()
+        if exc is None:
+            self.backend._store_task_reply(task.spec, fut.result(), task.pins)
+            with self.lock:
+                lease.busy = False
+                lease.idle_since = time.monotonic()
+            self._pump()
+            return
+        # transport failure: the leased worker is gone (crash/chaos).
+        self._drop_lease(lease)
+        if isinstance(exc, RpcError) and task.attempts <= task.spec.max_retries:
+            with self.lock:
+                self.pending.appendleft(task)
+            self._pump()
+        else:
+            self.backend._store_task_error(
+                task.spec,
+                WorkerCrashedError(
+                    f"worker died running {task.spec.name} "
+                    f"(attempt {task.attempts}): {exc}"),
+                task.pins)
+
+    def _drop_lease(self, lease: _Lease) -> None:
+        with self.lock:
+            self.leases.pop(lease.lease_id, None)
+        self.backend.peers.invalidate(lease.worker_addr)
+        try:
+            self.backend.head.call("release_lease",
+                                   {"lease_id": lease.lease_id}, timeout=5.0)
+        except RpcError:
+            pass
+
+    def reap_idle(self, linger_s: float) -> None:
+        now = time.monotonic()
+        with self.lock:
+            idle = [l for l in self.leases.values()
+                    if not l.busy and now - l.idle_since > linger_s
+                    and not self.pending]
+        for lease in idle:
+            with self.lock:
+                if lease.busy:
+                    continue
+                self.leases.pop(lease.lease_id, None)
+            try:
+                self.backend.head.call("release_lease",
+                                       {"lease_id": lease.lease_id},
+                                       timeout=5.0)
+            except RpcError:
+                pass
+
+    def shutdown(self) -> None:
+        with self.lock:
+            leases = list(self.leases.values())
+            self.leases.clear()
+        for lease in leases:
+            try:
+                self.backend.head.call("release_lease",
+                                       {"lease_id": lease.lease_id},
+                                       timeout=2.0)
+            except RpcError:
+                pass
+
+
+class _ActorSubmitter:
+    """Per-actor ordered submission with restart-aware re-resolution."""
+
+    def __init__(self, backend: "ClusterBackend", actor_id: ActorID,
+                 creation_pins: Optional[list] = None):
+        self.backend = backend
+        self.actor_id = actor_id
+        self.address: Optional[str] = None
+        self.state = "RESOLVING"
+        self.dead_reason = ""
+        self.pending: collections.deque = collections.deque()
+        self.lock = threading.Lock()
+        self.resolving = False
+        self._flushing = False
+        self.creation_pins = creation_pins or []
+        if self.creation_pins:
+            self._ensure_resolver()
+
+    def submit(self, payload: dict, spec: TaskSpec, pins: list) -> None:
+        t = _PendingTask(payload, spec, pins)
+        with self.lock:
+            if self.state == "DEAD":
+                dead = True
+            else:
+                dead = False
+                self.pending.append(t)
+        if dead:
+            self.backend._store_task_error(
+                spec, ActorDiedError(self.actor_id.hex(), self.dead_reason),
+                pins)
+            return
+        if self.state == "ALIVE":
+            self._flush()
+        else:
+            self._ensure_resolver()
+
+    def _ensure_resolver(self) -> None:
+        with self.lock:
+            if self.resolving:
+                return
+            self.resolving = True
+        threading.Thread(target=self._resolve_loop, daemon=True,
+                         name="actor-resolve").start()
+
+    def _resolve_loop(self) -> None:
+        try:
+            while not self.backend._closed:
+                try:
+                    info = self.backend.head.call_retrying(
+                        "get_actor", {"actor_id": self.actor_id.binary()})
+                except RpcError:
+                    time.sleep(0.2)
+                    continue
+                if info is None:
+                    self._mark_dead("actor not registered")
+                    return
+                if info["state"] == "ALIVE":
+                    with self.lock:
+                        self.address = info["address"]
+                        self.state = "ALIVE"
+                    self._release_creation_pins()
+                    self._flush()
+                    return
+                if info["state"] == "DEAD":
+                    self._mark_dead(info.get("reason", "actor died"))
+                    self._release_creation_pins()
+                    return
+                time.sleep(0.02)
+        finally:
+            with self.lock:
+                self.resolving = False
+
+    def _release_creation_pins(self) -> None:
+        with self.lock:
+            pins, self.creation_pins = self.creation_pins, []
+        for oid in pins:
+            self.backend.worker.refcounter.on_serialized_ref_done(oid)
+
+    def _requeue_ordered(self, task: _PendingTask) -> None:
+        """Re-insert a failed in-flight task preserving seq_no order —
+        several pipelined calls can fail together and their completion
+        callbacks run in arbitrary order, so a plain appendleft would
+        replay them reversed (per-handle ordering contract, reference:
+        ActorSchedulingQueue seq enforcement)."""
+        with self.lock:
+            items = list(self.pending)
+            items.append(task)
+            items.sort(key=lambda t: t.spec.seq_no)
+            self.pending = collections.deque(items)
+
+    def _mark_dead(self, reason: str) -> None:
+        with self.lock:
+            self.state = "DEAD"
+            self.dead_reason = reason
+            tasks = list(self.pending)
+            self.pending.clear()
+        for t in tasks:
+            self.backend._store_task_error(
+                t.spec, ActorDiedError(self.actor_id.hex(), reason), t.pins)
+
+    def _flush(self) -> None:
+        # Single-flusher discipline: exactly one thread drains the queue at
+        # a time, so tasks hit the wire (and the actor's FIFO queue) in
+        # seq_no order even when the resolver thread and a submitting user
+        # thread race into _flush together.
+        while True:
+            with self.lock:
+                if self._flushing:
+                    return
+                self._flushing = True
+            try:
+                while True:
+                    with self.lock:
+                        if self.state != "ALIVE" or not self.pending:
+                            break
+                        task = self.pending.popleft()
+                        addr = self.address
+                    task.attempts += 1
+                    client = self.backend.peers.get(addr)
+                    fut = client.call_async("push_task", task.payload)
+                    fut.add_done_callback(
+                        lambda f, t=task: self._on_reply(t, f))
+            finally:
+                with self.lock:
+                    self._flushing = False
+            with self.lock:
+                if self.state != "ALIVE" or not self.pending:
+                    return
+                # work arrived while we were clearing the flag — go again
+
+    def _on_reply(self, task: _PendingTask, fut) -> None:
+        exc = fut.exception()
+        if exc is None:
+            self.backend._store_task_reply(task.spec, fut.result(), task.pins)
+            return
+        # connection to the actor broke: restart-aware handling
+        # (reference: ActorTaskSubmitter disconnect path + max_task_retries,
+        # transport/actor_task_submitter.h:75)
+        with self.lock:
+            self.address = None
+            if self.state == "ALIVE":
+                self.state = "RESOLVING"
+        if isinstance(exc, RpcError) and task.attempts <= task.spec.max_retries:
+            self._requeue_ordered(task)
+            self._ensure_resolver()
+        else:
+            self.backend._store_task_error(
+                task.spec,
+                ActorDiedError(self.actor_id.hex(),
+                               f"actor task {task.spec.name} interrupted: "
+                               f"{exc}"),
+                task.pins)
+            self._ensure_resolver()
+
+
+class ClusterBackend:
+    """Backend interface implementation over the multiprocess runtime."""
+
+    def __init__(self, worker, head_addr: str, role: str,
+                 shm_name: Optional[str] = None,
+                 worker_id: Optional[WorkerID] = None,
+                 owned_procs: Optional[list] = None):
+        self.worker = worker
+        self.role = role
+        self.head = RpcClient(head_addr, name=f"{role}->head")
+        self.head_addr = head_addr
+        self.peers = ClientPool(name=f"{role}-peers")
+        self._closed = False
+        self._owned_procs = owned_procs or []
+        self._submitters: Dict[tuple, _TaskSubmitter] = {}
+        self._actor_submitters: Dict[ActorID, _ActorSubmitter] = {}
+        self._actor_name_cache: Dict[str, dict] = {}
+        self._fn_keys: Dict[int, str] = {}
+        self._lock = threading.Lock()
+
+        worker.worker_id = worker_id or WorkerID.from_random()
+
+        # node registry + local shm store
+        nodes = self.head.call_retrying("list_nodes")
+        node_addrs = {n["node_id"]: n["address"] for n in nodes}
+        node_shm = {n["node_id"]: n["shm_name"] for n in nodes}
+        if shm_name is None:
+            alive = [n for n in nodes if n["alive"]]
+            if not alive:
+                raise RuntimeError("cluster has no alive nodes")
+            local = alive[0]
+            shm_name = local["shm_name"]
+            local_node_id = local["node_id"]
+        else:
+            local_node_id = next(
+                (n["node_id"] for n in nodes if n["shm_name"] == shm_name),
+                nodes[0]["node_id"] if nodes else "")
+        store = ShmStore.attach(shm_name)
+        self.object_plane = ObjectPlane(
+            worker, local_node_id, store, self.head, node_addrs, node_shm)
+        self.local_node_id = local_node_id
+
+        # owner service: every process is reachable for object resolution
+        self.server = RpcServer({
+            "get_object": self.object_plane.handle_get_object,
+            "add_borrower": self.object_plane.handle_add_borrower,
+            "remove_borrower": self.object_plane.handle_remove_borrower,
+            "ping": lambda p, c: "pong",
+        }, name=f"{role}-owner")
+        self.head.call_retrying("kv_put", {
+            "key": f"addr:{worker.worker_id.hex()}",
+            "value": self.server.address})
+
+        # borrowed-ref owner map for unborrow notifications
+        self._borrowed_owner: Dict[ObjectID, WorkerID] = {}
+        worker.refcounter.notify_owner_unborrow = self._notify_unborrow
+
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name="lease-reaper")
+        self._reaper.start()
+
+    # ------------------------------------------------------------- factories
+
+    @classmethod
+    def connect_as_driver(cls, worker, head_addr: str,
+                          owned_procs: Optional[list] = None
+                          ) -> "ClusterBackend":
+        backend = cls(worker, head_addr, role="driver",
+                      owned_procs=owned_procs)
+        info = backend.head.call_retrying("connect_driver", {})
+        worker.job_id = JobID.from_int(info["job_id"])
+        from ray_tpu.core.ids import TaskID
+        worker.current_task_id = TaskID.for_driver(worker.job_id)
+        worker.node_id = backend.local_node_id
+        worker.connect_cluster(backend)
+        backend._install_cluster_hooks()
+        return backend
+
+    @classmethod
+    def connect_as_worker(cls, worker, head_addr: str, shm_name: str,
+                          worker_id: WorkerID) -> "ClusterBackend":
+        backend = cls(worker, head_addr, role="worker", shm_name=shm_name,
+                      worker_id=worker_id)
+        worker.job_id = JobID.from_int(0)
+        from ray_tpu.core.ids import TaskID
+        worker.current_task_id = None
+        worker.node_id = backend.local_node_id
+        worker.mode = "worker"
+        worker.backend = backend
+        worker._install_hooks()
+        backend._install_cluster_hooks()
+        return backend
+
+    def _install_cluster_hooks(self) -> None:
+        from ray_tpu.core import object_ref as object_ref_mod
+        object_ref_mod.install_refcount_hooks(
+            add=lambda oid: self.worker.refcounter.add_local(oid),
+            remove=self._on_ref_removed,
+            borrow=lambda oid: self.worker.refcounter.on_ref_serialized(oid),
+            deserialized=self._on_ref_deserialized,
+        )
+        self.worker.refcounter.free_object = self.worker._free_object
+
+    # ----------------------------------------------------- refcount plumbing
+
+    def _on_ref_deserialized(self, ref: ObjectRef) -> None:
+        if ref.owner_id() == self.worker.worker_id or ref.owner_id().is_nil():
+            return
+        with self._lock:
+            first = ref.id() not in self._borrowed_owner
+            self._borrowed_owner[ref.id()] = ref.owner_id()
+        if first:
+            try:
+                self.object_plane.owner_client(ref.owner_id()).call(
+                    "add_borrower", {
+                        "object_id": ref.id().binary(),
+                        "borrower": self.worker.worker_id.binary()})
+            except Exception:
+                pass
+        self.worker.refcounter.on_ref_deserialized(ref.id())
+
+    def _on_ref_removed(self, oid: ObjectID) -> None:
+        self.worker.refcounter.remove_local(oid)
+
+    def _notify_unborrow(self, oid: ObjectID) -> None:
+        with self._lock:
+            owner = self._borrowed_owner.pop(oid, None)
+        self.object_plane.release_local_pin(oid)
+        if owner is None:
+            return
+        try:
+            self.object_plane.owner_client(owner).call(
+                "remove_borrower", {
+                    "object_id": oid.binary(),
+                    "borrower": self.worker.worker_id.binary()})
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- objects
+
+    def put_object(self, object_id: ObjectID, value: Any) -> None:
+        self.object_plane.put_object(object_id, value)
+
+    def free_object(self, object_id: ObjectID) -> None:
+        self.object_plane.free_object(object_id)
+
+    def try_resolve(self, ref: ObjectRef) -> bool:
+        return self.object_plane.try_resolve(ref)
+
+    def poke_resolve(self, ref: ObjectRef) -> None:
+        self.object_plane.poke_resolve(ref)
+
+    def get_from_store(self, ref: ObjectRef) -> Tuple[Any, bool]:
+        return self.object_plane.get_from_store(ref)
+
+    # ----------------------------------------------------------------- tasks
+
+    def _export_function(self, fn) -> str:
+        key = self._fn_keys.get(id(fn))
+        if key is None:
+            key, blob = wire.export_function(fn)
+            self.head.call_retrying("kv_put", {
+                "key": key, "value": blob, "overwrite": False})
+            self._fn_keys[id(fn)] = key
+        return key
+
+    def submit_task(self, spec: TaskSpec) -> None:
+        key = self._export_function(spec.function)
+        payload, contained = wire.task_to_wire(spec, function_key=key)
+        pins = self._pin_args(spec, contained)
+        shape_key = tuple(sorted(spec.resources.items()))
+        with self._lock:
+            sub = self._submitters.get(shape_key)
+            if sub is None:
+                sub = _TaskSubmitter(self, shape_key, dict(spec.resources))
+                self._submitters[shape_key] = sub
+        sub.submit(payload, spec, pins)
+
+    def _pin_args(self, spec: TaskSpec, contained: list) -> list:
+        """Collect refs pinned until the task's reply arrives.
+
+        Top-level ref args were pinned by worker.make_task_args
+        (on_ref_serialized); nested refs inside inline values were pinned by
+        the serialize-time borrow hook (ObjectRef.__reduce__). Each gets
+        exactly one on_serialized_ref_done at reply time.
+        """
+        pins = [a.object_id for a in spec.args if a.is_ref]
+        pins.extend(r.id() for r in contained)
+        return pins
+
+    def _store_task_reply(self, spec: TaskSpec, reply: dict,
+                          pins: list) -> None:
+        if reply.get("cancelled"):
+            self._store_task_error(
+                spec, TaskCancelledError(spec.task_id.hex()), pins)
+            return
+        rids = spec.return_ids()
+        for rid, res in zip(rids, reply["results"]):
+            if "in_shm" in res:
+                self.object_plane.record_remote_location(rid, res["in_shm"])
+            else:
+                value = serialization.deserialize(res["inline"])
+                self.worker.memory_store.put(rid, value,
+                                             is_error=res["is_error"])
+        self._unpin(pins)
+
+    def _store_task_error(self, spec: TaskSpec, exc: BaseException,
+                          pins: list) -> None:
+        for rid in spec.return_ids():
+            self.worker.memory_store.put(rid, exc, is_error=True)
+        self._unpin(pins)
+
+    def _unpin(self, pins: list) -> None:
+        for oid in pins:
+            self.worker.refcounter.on_serialized_ref_done(oid)
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
+        tid = ref.id().task_id().binary()
+        with self._lock:
+            subs = list(self._submitters.values())
+        for sub in subs:
+            if sub.cancel(tid):
+                return
+
+    # ---------------------------------------------------------------- actors
+
+    def create_actor(self, spec: ActorCreationSpec) -> None:
+        payload, contained = wire.actor_to_wire(spec)
+        pins = [a.object_id for a in spec.args if a.is_ref]
+        pins.extend(r.id() for r in contained)
+        import pickle
+        name_key = (f"{spec.namespace}:{spec.registered_name}"
+                    if spec.registered_name else "")
+        self.head.call_retrying("create_actor", {
+            "actor_id": spec.actor_id.binary(),
+            "spec_bytes": pickle.dumps(payload, protocol=5),
+            "max_restarts": spec.max_restarts,
+            "max_task_retries": spec.max_task_retries,
+            "name_key": name_key,
+            "resources": spec.resources,
+            "owner_addr": self.server.address,
+            "class_name": spec.name,
+        })
+        with self._lock:
+            self._actor_submitters[spec.actor_id] = _ActorSubmitter(
+                self, spec.actor_id, creation_pins=pins)
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        payload, contained = wire.task_to_wire(spec)
+        pins = self._pin_args(spec, contained)
+        with self._lock:
+            sub = self._actor_submitters.get(spec.actor_id)
+            if sub is None:
+                sub = _ActorSubmitter(self, spec.actor_id)
+                self._actor_submitters[spec.actor_id] = sub
+        sub.submit(payload, spec, pins)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.head.call_retrying("kill_actor", {
+            "actor_id": actor_id.binary(), "no_restart": no_restart})
+
+    def get_actor_by_name(self, name: str, namespace: str):
+        info = self.head.call_retrying("get_actor_by_name", {
+            "name": name, "namespace": namespace})
+        if info is None:
+            return None
+        spec = ActorCreationSpec(
+            actor_id=ActorID(info["actor_id"]), name=info["class_name"],
+            registered_name=name, namespace=namespace,
+            max_task_retries=info["max_task_retries"])
+        return spec
+
+    # ------------------------------------------------------------------ misc
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.head.call_retrying("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.head.call_retrying("available_resources")
+
+    def nodes(self) -> list:
+        out = []
+        for n in self.head.call_retrying("list_nodes"):
+            out.append({"NodeID": n["node_id"], "Alive": n["alive"],
+                        "Resources": n["resources"],
+                        "Address": n["address"]})
+        return out
+
+    def state_dump(self) -> dict:
+        return self.head.call_retrying("state_dump")
+
+    def _reap_loop(self) -> None:
+        cfg = config_mod.GlobalConfig
+        while not self._closed:
+            time.sleep(0.2)
+            with self._lock:
+                subs = list(self._submitters.values())
+            for sub in subs:
+                try:
+                    sub.reap_idle(cfg.lease_idle_linger_s)
+                except Exception:
+                    pass
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            subs = list(self._submitters.values())
+        for sub in subs:
+            sub.shutdown()
+        try:
+            self.head.call("kv_del",
+                           {"key": f"addr:{self.worker.worker_id.hex()}"},
+                           timeout=2.0)
+        except RpcError:
+            pass
+        self.server.stop()
+        self.object_plane.shutdown()
+        self.peers.close_all()
+        self.head.close()
+        # tear down processes we started (driver that booted the cluster)
+        for proc in reversed(self._owned_procs):
+            try:
+                proc.terminate()
+                proc.wait(timeout=5.0)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# bootstrap
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_ready(address: str, proc: subprocess.Popen, what: str,
+                timeout: float = 30.0) -> None:
+    client = RpcClient(address, name="bootstrap")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited rc={proc.returncode} during startup")
+        try:
+            client.call("ping", timeout=1.0)
+            client.close()
+            return
+        except RpcError:
+            time.sleep(0.05)
+    client.close()
+    raise RuntimeError(f"{what} not ready after {timeout}s")
+
+
+def start_head(session: str, port: Optional[int] = None
+               ) -> Tuple[subprocess.Popen, str]:
+    port = port or _free_port()
+    cmd = [sys.executable, "-m", "ray_tpu.runtime.head", str(port), session,
+           config_mod.GlobalConfig.to_json()]
+    proc = subprocess.Popen(cmd, env=_child_env())
+    address = f"127.0.0.1:{port}"
+    _wait_ready(address, proc, "head")
+    return proc, address
+
+
+def start_node(head_addr: str, session: str,
+               resources: Optional[Dict[str, float]] = None,
+               object_store_bytes: Optional[int] = None) -> subprocess.Popen:
+    args = {"resources": resources,
+            "object_store_bytes": object_store_bytes,
+            "config": json.loads(config_mod.GlobalConfig.to_json())}
+    cmd = [sys.executable, "-m", "ray_tpu.runtime.node", head_addr, session,
+           json.dumps(args)]
+    return subprocess.Popen(cmd, env=_child_env())
+
+
+def connect_or_start(worker, address: Optional[str] = None,
+                     num_cpus: Optional[int] = None,
+                     num_tpus: Optional[int] = None,
+                     resources: Optional[Dict[str, float]] = None,
+                     object_store_memory: Optional[int] = None,
+                     namespace: str = "default") -> Dict[str, Any]:
+    owned: list = []
+    if address is None:
+        session = os.urandom(4).hex()
+        head_proc, address = start_head(session)
+        owned.append(head_proc)
+        merged = dict(resources or {})
+        merged.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                       else (os.cpu_count() or 1)))
+        if num_tpus is not None:
+            merged["TPU"] = float(num_tpus)
+        node_proc = start_node(address, session, resources=merged,
+                               object_store_bytes=object_store_memory)
+        owned.append(node_proc)
+        # wait until the node registers
+        probe = RpcClient(address, name="probe")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if node_proc.poll() is not None:
+                raise RuntimeError(
+                    f"node daemon exited rc={node_proc.returncode}")
+            try:
+                if any(n["alive"] for n in probe.call("list_nodes")):
+                    break
+            except RpcError:
+                pass
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("node daemon never registered")
+        probe.close()
+
+    backend = ClusterBackend.connect_as_driver(worker, address,
+                                               owned_procs=owned)
+    return {"address": address, "node_id": backend.local_node_id}

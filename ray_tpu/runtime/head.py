@@ -1,0 +1,657 @@
+"""Head service — the global control plane (GCS role).
+
+Role-equivalent to the reference's GcsServer (reference:
+src/ray/gcs/gcs_server/gcs_server.h:89) with its managers collapsed into one
+process: node membership (gcs_node_manager.h:45), actor directory + restart
+orchestration (gcs_actor_manager.h:324, RestartActor at
+gcs_actor_manager.cc:413), internal KV (gcs_kv_manager.h), health checks
+(gcs_health_check_manager.h:45), and cluster-level scheduling decisions
+(delegated to the C++ ClusterState, the role of
+raylet/scheduling/cluster_resource_scheduler.h:44 — here centralized since
+lease accounting lives on the head, not gossiped).
+
+Leases: a client asks the head for (node, worker) to run a resource shape;
+the head acquires resources, asks the node daemon to pop a worker from its
+pool, and hands back the worker address. The client pushes tasks directly
+to the worker (the reference's lease + direct PushTask design,
+transport/normal_task_submitter.h:74) and releases the lease when idle.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import config as config_mod
+from ray_tpu.core._native import (POLICY_HYBRID, POLICY_NODE_AFFINITY,
+                                  POLICY_SPREAD, ClusterState)
+from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
+
+# actor states (reference: gcs.proto ActorTableData.ActorState)
+PENDING = "PENDING"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+_POLICY_BY_NAME = {
+    "hybrid": POLICY_HYBRID,
+    "spread": POLICY_SPREAD,
+    "node_affinity": POLICY_NODE_AFFINITY,
+}
+
+
+class _NodeEntry:
+    __slots__ = ("node_id", "address", "shm_name", "resources", "alive",
+                 "last_seen", "missed")
+
+    def __init__(self, node_id: str, address: str, shm_name: str,
+                 resources: Dict[str, float]):
+        self.node_id = node_id
+        self.address = address
+        self.shm_name = shm_name
+        self.resources = resources
+        self.alive = True
+        self.last_seen = time.monotonic()
+        self.missed = 0
+
+
+class _ActorEntry:
+    __slots__ = ("actor_id", "spec_bytes", "state", "address", "node_id",
+                 "worker_id", "restarts_left", "max_task_retries", "reason",
+                 "name_key", "resources", "owner_addr", "class_name",
+                 "num_restarts")
+
+    def __init__(self, actor_id: bytes, spec_bytes: bytes, restarts_left: int,
+                 max_task_retries: int, name_key: str,
+                 resources: Dict[str, float], owner_addr: str,
+                 class_name: str):
+        self.actor_id = actor_id
+        self.spec_bytes = spec_bytes
+        self.state = PENDING
+        self.address: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.worker_id: Optional[bytes] = None
+        self.restarts_left = restarts_left
+        self.max_task_retries = max_task_retries
+        self.reason = ""
+        self.name_key = name_key
+        self.resources = resources
+        self.owner_addr = owner_addr
+        self.class_name = class_name
+        self.num_restarts = 0
+
+
+class _LeaseEntry:
+    __slots__ = ("lease_id", "node_id", "worker_id", "worker_addr",
+                 "resources", "created", "peer")
+
+    def __init__(self, lease_id: str, node_id: str, worker_id: bytes,
+                 worker_addr: str, resources: Dict[str, float], peer):
+        self.lease_id = lease_id
+        self.node_id = node_id
+        self.worker_id = worker_id
+        self.worker_addr = worker_addr
+        self.resources = resources
+        self.created = time.monotonic()
+        self.peer = peer  # requesting connection; leases die with it
+
+
+class Head:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 session: str = ""):
+        self.session = session
+        self.cluster = ClusterState()
+        cfg = config_mod.GlobalConfig
+        self.cluster.set_spread_threshold(cfg.scheduler_spread_threshold)
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, _NodeEntry] = {}
+        self._actors: Dict[bytes, _ActorEntry] = {}
+        self._named: Dict[str, bytes] = {}  # "ns:name" -> actor_id
+        self._actor_by_worker: Dict[bytes, bytes] = {}  # worker_id -> actor_id
+        self._kv: Dict[str, bytes] = {}
+        self._leases: Dict[str, _LeaseEntry] = {}
+        self._lease_counter = 0
+        self._next_job = 0
+        self._pgs: Dict[bytes, dict] = {}  # PlacementGroupID bin -> info
+        self._node_clients = ClientPool(name="head->node")
+        self._stopped = threading.Event()
+        self.server = RpcServer({
+            "register_node": self._h_register_node,
+            "unregister_node": self._h_unregister_node,
+            "list_nodes": self._h_list_nodes,
+            "connect_driver": self._h_connect_driver,
+            "kv_put": self._h_kv_put,
+            "kv_get": self._h_kv_get,
+            "kv_del": self._h_kv_del,
+            "kv_keys": self._h_kv_keys,
+            "request_lease": self._h_request_lease,
+            "release_lease": self._h_release_lease,
+            "create_actor": self._h_create_actor,
+            "actor_ready": self._h_actor_ready,
+            "actor_failed": self._h_actor_failed,
+            "get_actor": self._h_get_actor,
+            "get_actor_by_name": self._h_get_actor_by_name,
+            "kill_actor": self._h_kill_actor,
+            "worker_died": self._h_worker_died,
+            "create_placement_group": self._h_create_pg,
+            "remove_placement_group": self._h_remove_pg,
+            "get_placement_group": self._h_get_pg,
+            "cluster_resources": self._h_cluster_resources,
+            "available_resources": self._h_available_resources,
+            "state_dump": self._h_state_dump,
+            "ping": lambda p, c: "pong",
+        }, host=host, port=port, max_workers=32, name="head")
+        # a crashed client can't release its leases; reclaim them when its
+        # connection drops (reference: raylet returns leased workers when
+        # the owner dies — lease lifetime is bound to the owner)
+        self.server.on_disconnect = self._on_client_disconnect
+        self.address = self.server.address
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="head-health")
+        self._health_thread.start()
+
+    # ------------------------------------------------------------- membership
+
+    def _h_register_node(self, p, ctx):
+        node_id = p["node_id"]
+        with self._lock:
+            entry = _NodeEntry(node_id, p["address"], p["shm_name"],
+                               p["resources"])
+            self._nodes[node_id] = entry
+            self.cluster.add_node(node_id, p["resources"])
+        return {"session": self.session}
+
+    def _h_unregister_node(self, p, ctx):
+        self._mark_node_dead(p["node_id"], "unregistered")
+        return True
+
+    def _h_list_nodes(self, p, ctx):
+        with self._lock:
+            return [{"node_id": n.node_id, "address": n.address,
+                     "shm_name": n.shm_name, "resources": n.resources,
+                     "alive": n.alive}
+                    for n in self._nodes.values()]
+
+    def _h_connect_driver(self, p, ctx):
+        with self._lock:
+            self._next_job += 1
+            job = self._next_job
+        return {"job_id": job, "session": self.session,
+                "nodes": self._h_list_nodes(None, None)}
+
+    # --------------------------------------------------------------------- kv
+
+    def _h_kv_put(self, p, ctx):
+        with self._lock:
+            exists = p["key"] in self._kv
+            if p.get("overwrite", True) or not exists:
+                self._kv[p["key"]] = p["value"]
+        return not exists
+
+    def _h_kv_get(self, p, ctx):
+        with self._lock:
+            return self._kv.get(p["key"])
+
+    def _h_kv_del(self, p, ctx):
+        with self._lock:
+            return self._kv.pop(p["key"], None) is not None
+
+    def _h_kv_keys(self, p, ctx):
+        prefix = p.get("prefix", "")
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # ----------------------------------------------------------------- leases
+
+    def _schedule_and_acquire(self, resources: Dict[str, float],
+                              policy: str = "hybrid",
+                              affinity_node: str = "",
+                              soft: bool = False) -> Optional[str]:
+        with self._lock:
+            node_id = self.cluster.schedule(
+                resources, _POLICY_BY_NAME.get(policy, POLICY_HYBRID),
+                affinity_node=affinity_node, soft=soft)
+            if node_id is None:
+                return None
+            if not self.cluster.acquire(node_id, resources):
+                return None
+            return node_id
+
+    def _release(self, node_id: str, resources: Dict[str, float]) -> None:
+        with self._lock:
+            if node_id in self._nodes and self._nodes[node_id].alive:
+                self.cluster.release(node_id, resources)
+
+    def _h_request_lease(self, p, ctx):
+        """Grant (node, worker) for a resource shape; None if infeasible now.
+
+        Reply: {lease_id, node_id, worker_id, worker_addr, shm_name} or
+        {retry: True} when resources are busy, or {infeasible: True} when no
+        node could ever satisfy the shape.
+        """
+        resources = p["resources"]
+        node_id = self._schedule_and_acquire(
+            resources, policy=p.get("policy", "hybrid"),
+            affinity_node=p.get("affinity_node", ""),
+            soft=p.get("soft", False))
+        if node_id is None:
+            # distinguish busy from impossible: try against total capacity
+            with self._lock:
+                feasible = any(
+                    all(n.resources.get(k, 0.0) >= v
+                        for k, v in resources.items())
+                    for n in self._nodes.values() if n.alive)
+            return {"infeasible": not feasible, "retry": feasible}
+        node = self._nodes[node_id]
+        try:
+            grant = self._node_clients.get(node.address).call(
+                "lease_worker", {"resources": resources})
+        except RpcError as e:
+            self._release(node_id, resources)
+            self._mark_node_dead(node_id, f"lease rpc failed: {e}")
+            return {"retry": True}
+        if grant is None:
+            self._release(node_id, resources)
+            return {"retry": True}
+        with self._lock:
+            self._lease_counter += 1
+            lease_id = f"l{self._lease_counter}"
+            self._leases[lease_id] = _LeaseEntry(
+                lease_id, node_id, grant["worker_id"], grant["worker_addr"],
+                resources, ctx.peer if ctx is not None else None)
+        return {"lease_id": lease_id, "node_id": node_id,
+                "worker_id": grant["worker_id"],
+                "worker_addr": grant["worker_addr"],
+                "shm_name": node.shm_name}
+
+    def _on_client_disconnect(self, peer) -> None:
+        with self._lock:
+            stale = [l.lease_id for l in self._leases.values()
+                     if l.peer == peer]
+        for lease_id in stale:
+            self._h_release_lease({"lease_id": lease_id}, None)
+
+    def _h_release_lease(self, p, ctx):
+        with self._lock:
+            lease = self._leases.pop(p["lease_id"], None)
+        if lease is None:
+            return False
+        self._release(lease.node_id, lease.resources)
+        node = self._nodes.get(lease.node_id)
+        if node is not None and node.alive:
+            try:
+                self._node_clients.get(node.address).call(
+                    "return_worker", {"worker_id": lease.worker_id})
+            except RpcError:
+                pass
+        return True
+
+    # ----------------------------------------------------------------- actors
+
+    def _h_create_actor(self, p, ctx):
+        """Register + schedule an actor. Reply immediately; creation is async.
+
+        (Reference: GcsActorManager::RegisterActor/CreateActor,
+        gcs_actor_manager.cc:389,475 — the client gets an immediate ack and
+        discovers liveness through get_actor polling.)
+        """
+        actor_id: bytes = p["actor_id"]
+        entry = _ActorEntry(
+            actor_id, p["spec_bytes"], p["max_restarts"],
+            p["max_task_retries"], p.get("name_key", ""),
+            p["resources"], p.get("owner_addr", ""), p.get("class_name", ""))
+        with self._lock:
+            if entry.name_key:
+                if entry.name_key in self._named:
+                    raise ValueError(
+                        f"named actor {entry.name_key!r} already exists")
+                self._named[entry.name_key] = actor_id
+            self._actors[actor_id] = entry
+        self._spawn_actor(entry)
+        return True
+
+    def _spawn_actor(self, entry: _ActorEntry) -> None:
+        """Try to place the actor; retries in a background thread if busy."""
+
+        def _try_place():
+            deadline = time.monotonic() + config_mod.GlobalConfig.rpc_call_timeout_s
+            while not self._stopped.is_set():
+                with self._lock:
+                    if entry.state == DEAD:
+                        return  # killed while pending placement
+                node_id = self._schedule_and_acquire(entry.resources)
+                if node_id is not None:
+                    node = self._nodes[node_id]
+                    try:
+                        grant = self._node_clients.get(node.address).call(
+                            "lease_worker", {"resources": entry.resources})
+                    except RpcError:
+                        self._release(node_id, entry.resources)
+                        self._mark_node_dead(node_id, "actor lease rpc failed")
+                        continue
+                    if grant is None:
+                        self._release(node_id, entry.resources)
+                        time.sleep(0.05)
+                        continue
+                    with self._lock:
+                        if entry.state == DEAD:  # killed during the lease
+                            self._release(node_id, entry.resources)
+                            grant_dead = True
+                        else:
+                            grant_dead = False
+                            entry.node_id = node_id
+                            entry.worker_id = grant["worker_id"]
+                            self._actor_by_worker[grant["worker_id"]] = \
+                                entry.actor_id
+                    if grant_dead:
+                        try:
+                            self._node_clients.get(node.address).call(
+                                "return_worker",
+                                {"worker_id": grant["worker_id"]})
+                        except RpcError:
+                            pass
+                        return
+                    try:
+                        self._node_clients.get(node.address).call(
+                            "start_actor", {
+                                "worker_id": grant["worker_id"],
+                                "actor_id": entry.actor_id,
+                                "spec_bytes": entry.spec_bytes,
+                                "head_addr": self.address,
+                                "num_restarts": entry.num_restarts,
+                            })
+                    except RpcError as e:
+                        self._on_actor_worker_lost(entry.actor_id,
+                                                   f"start_actor failed: {e}")
+                    return
+                # infeasible forever?
+                with self._lock:
+                    feasible = any(
+                        all(n.resources.get(k, 0.0) >= v
+                            for k, v in entry.resources.items())
+                        for n in self._nodes.values() if n.alive)
+                if not feasible and time.monotonic() > deadline:
+                    with self._lock:
+                        entry.state = DEAD
+                        entry.reason = (
+                            f"infeasible resources {entry.resources}")
+                    return
+                time.sleep(0.02)
+
+        threading.Thread(target=_try_place, daemon=True,
+                         name="head-actor-place").start()
+
+    def _h_actor_ready(self, p, ctx):
+        with self._lock:
+            entry = self._actors.get(p["actor_id"])
+            if entry is None:
+                return False
+            # Restart fencing: a stale incarnation (e.g. a slow __init__
+            # finishing after the head already declared the worker lost and
+            # restarted elsewhere) must not flip state back to ALIVE.
+            if p.get("num_restarts", 0) != entry.num_restarts or \
+                    entry.state == DEAD:
+                return False
+            entry.state = ALIVE
+            entry.address = p["address"]
+        return True
+
+    def _h_actor_failed(self, p, ctx):
+        """Actor constructor raised — not a crash; no restart (reference
+        semantics: creation errors surface to the caller)."""
+        with self._lock:
+            entry = self._actors.get(p["actor_id"])
+            if entry is None:
+                return False
+            if p.get("num_restarts", 0) != entry.num_restarts or \
+                    entry.state == DEAD:
+                return False
+            entry.state = DEAD
+            entry.reason = p.get("reason", "creation failed")
+            node = self._nodes.get(entry.node_id) if entry.node_id else None
+            worker_id = entry.worker_id
+            self._cleanup_actor_placement(entry)
+        # the worker process held partial constructor state — reclaim the
+        # pool slot by killing it (its death event no-ops: actor is DEAD)
+        if node is not None and worker_id is not None and node.alive:
+            try:
+                self._node_clients.get(node.address).call(
+                    "kill_worker", {"worker_id": worker_id})
+            except RpcError:
+                pass
+        return True
+
+    def _cleanup_actor_placement(self, entry: _ActorEntry) -> None:
+        """Release resources + pool bookkeeping after an actor leaves a node.
+
+        Caller must hold self._lock.
+        """
+        if entry.worker_id is not None:
+            self._actor_by_worker.pop(entry.worker_id, None)
+        if entry.node_id is not None and entry.node_id in self._nodes:
+            if self._nodes[entry.node_id].alive:
+                self.cluster.release(entry.node_id, entry.resources)
+        entry.node_id = None
+        entry.worker_id = None
+        entry.address = None
+
+    def _h_get_actor(self, p, ctx):
+        with self._lock:
+            entry = self._actors.get(p["actor_id"])
+            if entry is None:
+                return None
+            return {"state": entry.state, "address": entry.address,
+                    "reason": entry.reason,
+                    "max_task_retries": entry.max_task_retries,
+                    "num_restarts": entry.num_restarts}
+
+    def _h_get_actor_by_name(self, p, ctx):
+        key = f"{p['namespace']}:{p['name']}"
+        with self._lock:
+            actor_id = self._named.get(key)
+            if actor_id is None:
+                return None
+            entry = self._actors[actor_id]
+            return {"actor_id": actor_id, "class_name": entry.class_name,
+                    "state": entry.state,
+                    "max_task_retries": entry.max_task_retries}
+
+    def _h_kill_actor(self, p, ctx):
+        actor_id = p["actor_id"]
+        with self._lock:
+            entry = self._actors.get(actor_id)
+            if entry is None:
+                return False
+            if p.get("no_restart", True):
+                entry.restarts_left = 0
+            node = self._nodes.get(entry.node_id) if entry.node_id else None
+            worker_id = entry.worker_id
+            if worker_id is None and entry.state in (PENDING, RESTARTING) \
+                    and p.get("no_restart", True):
+                # not placed yet: mark dead now so the in-flight placement
+                # loop aborts instead of starting a killed actor
+                entry.state = DEAD
+                entry.reason = "killed before start"
+        if node is not None and worker_id is not None:
+            try:
+                self._node_clients.get(node.address).call(
+                    "kill_worker", {"worker_id": worker_id})
+            except RpcError:
+                pass
+        return True
+
+    # --------------------------------------------------- death + restart path
+
+    def _h_worker_died(self, p, ctx):
+        """Node daemon reports a worker process exit (reference: raylet
+        worker death -> GcsActorManager::OnWorkerDead)."""
+        self._on_actor_worker_lost(
+            None, p.get("reason", "worker died"),
+            worker_id=p["worker_id"])
+        return True
+
+    def _on_actor_worker_lost(self, actor_id: Optional[bytes], reason: str,
+                              worker_id: Optional[bytes] = None) -> None:
+        with self._lock:
+            if actor_id is None and worker_id is not None:
+                actor_id = self._actor_by_worker.get(worker_id)
+            if actor_id is None:
+                return  # plain task worker; owners detect via connection loss
+            entry = self._actors.get(actor_id)
+            if entry is None or entry.state == DEAD:
+                return
+            self._cleanup_actor_placement(entry)
+            if entry.restarts_left != 0:
+                if entry.restarts_left > 0:
+                    entry.restarts_left -= 1
+                entry.state = RESTARTING
+                entry.num_restarts += 1
+                restart = True
+            else:
+                entry.state = DEAD
+                entry.reason = reason
+                restart = False
+        if restart:
+            self._spawn_actor(entry)
+
+    def _mark_node_dead(self, node_id: str, reason: str) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            self.cluster.remove_node(node_id)
+            dead_actor_ids = [aid for aid, e in self._actors.items()
+                              if e.node_id == node_id and
+                              e.state in (ALIVE, PENDING, RESTARTING)]
+        self._node_clients.invalidate(node.address)
+        for aid in dead_actor_ids:
+            self._on_actor_worker_lost(aid, f"node {node_id} died: {reason}")
+
+    def _health_loop(self) -> None:
+        cfg = config_mod.GlobalConfig
+        period = cfg.health_check_period_ms / 1000.0
+        max_missed = max(1, int(cfg.health_check_timeout_ms /
+                                cfg.health_check_period_ms))
+        while not self._stopped.wait(period):
+            with self._lock:
+                nodes = [n for n in self._nodes.values() if n.alive]
+            for n in nodes:
+                try:
+                    self._node_clients.get(n.address).call(
+                        "ping", timeout=period * 2)
+                    n.missed = 0
+                    n.last_seen = time.monotonic()
+                except RpcError:
+                    n.missed += 1
+                    if n.missed >= max_missed:
+                        self._mark_node_dead(n.node_id, "health check failed")
+
+    # ------------------------------------------------------- placement groups
+
+    def _h_create_pg(self, p, ctx):
+        """All-or-nothing bundle reservation (reference:
+        GcsPlacementGroupManager, gcs_placement_group_manager.h:228)."""
+        with self._lock:
+            nodes = self.cluster.schedule_bundles(p["bundles"], p["strategy"])
+            if nodes is None:
+                return None
+            self._pgs[p["pg_id"]] = {
+                "bundles": p["bundles"], "nodes": nodes,
+                "strategy": p["strategy"], "name": p.get("name", "")}
+        return {"nodes": nodes}
+
+    def _h_remove_pg(self, p, ctx):
+        with self._lock:
+            pg = self._pgs.pop(p["pg_id"], None)
+            if pg is None:
+                return False
+            for node_id, bundle in zip(pg["nodes"], pg["bundles"]):
+                if node_id in self._nodes and self._nodes[node_id].alive:
+                    self.cluster.release(node_id, bundle)
+        return True
+
+    def _h_get_pg(self, p, ctx):
+        with self._lock:
+            pg = self._pgs.get(p["pg_id"])
+            if pg is None:
+                return None
+            return dict(pg)
+
+    # ------------------------------------------------------------------ state
+
+    def _h_cluster_resources(self, p, ctx):
+        with self._lock:
+            total: Dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources.items():
+                    total[k] = total.get(k, 0.0) + v
+            return total
+
+    def _h_available_resources(self, p, ctx):
+        total = self._h_cluster_resources(p, ctx)
+        with self._lock:
+            for lease in self._leases.values():
+                for k, v in lease.resources.items():
+                    total[k] = total.get(k, 0.0) - v
+            for e in self._actors.values():
+                if e.state in (ALIVE, PENDING, RESTARTING) and e.node_id:
+                    for k, v in e.resources.items():
+                        total[k] = total.get(k, 0.0) - v
+        return total
+
+    def _h_state_dump(self, p, ctx):
+        with self._lock:
+            return {
+                "nodes": [{"node_id": n.node_id, "address": n.address,
+                           "alive": n.alive, "resources": n.resources}
+                          for n in self._nodes.values()],
+                "actors": [{"actor_id": aid.hex(), "class": e.class_name,
+                            "state": e.state, "node_id": e.node_id,
+                            "name": e.name_key, "restarts": e.num_restarts,
+                            "reason": e.reason}
+                           for aid, e in self._actors.items()],
+                "leases": len(self._leases),
+                "placement_groups": [
+                    {"pg_id": pid.hex(), "strategy": pg["strategy"],
+                     "nodes": pg["nodes"], "name": pg["name"]}
+                    for pid, pg in self._pgs.items()],
+            }
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.server.stop()
+        self._node_clients.close_all()
+
+
+def main() -> None:
+    """Entrypoint: ``python -m ray_tpu.runtime.head <port> <session>``."""
+    import signal
+
+    port = int(sys.argv[1])
+    session = sys.argv[2]
+    if len(sys.argv) > 3:
+        config_mod.GlobalConfig.apply(json.loads(sys.argv[3]))
+    head = Head(port=port, session=session)
+    stop = threading.Event()
+
+    def _term(*_):
+        head.stop()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    print(f"RTPU_HEAD_READY {head.address}", flush=True)
+    try:
+        while not stop.wait(3600):
+            pass
+    except KeyboardInterrupt:
+        head.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,290 @@
+"""Per-node daemon — worker pool, leases, shm store host (raylet role).
+
+Role-equivalent to the reference's raylet (reference:
+src/ray/raylet/node_manager.h:118 — lease protocol at :554; worker pool at
+src/ray/raylet/worker_pool.h:224): owns the node's shared-memory object
+store, spawns/monitors worker processes, grants leased workers to the head,
+and serves cross-node object reads (role of the object manager's push/pull,
+src/ray/object_manager/object_manager.h — collapsed into a read RPC since
+every peer reaches us over TCP directly).
+
+Worker death is detected by a waiter thread per child process (reference:
+raylet worker death via process waits) and reported to the head so actor
+restart logic runs (gcs_actor_manager.cc:413).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import config as config_mod
+from ray_tpu.core._native import ShmStore
+from ray_tpu.core.ids import NodeID, WorkerID
+from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
+
+
+class _WorkerEntry:
+    __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id")
+
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address: Optional[str] = None
+        self.ready = threading.Event()
+        self.state = "starting"  # starting | idle | leased | actor | dead
+        self.actor_id: Optional[bytes] = None
+
+
+class NodeDaemon:
+    def __init__(self, head_addr: str, session: str,
+                 resources: Dict[str, float],
+                 object_store_bytes: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        cfg = config_mod.GlobalConfig
+        self.head_addr = head_addr
+        self.session = session
+        self.node_id = NodeID.from_random().hex()
+        self.resources = dict(resources)
+        self.shm_name = f"/rtpu_{session[:8]}_{self.node_id[:8]}"
+        self.store = ShmStore.create(
+            self.shm_name,
+            object_store_bytes or cfg.object_store_memory_bytes,
+            cfg.object_store_max_objects)
+        self._lock = threading.RLock()
+        self._workers: Dict[bytes, _WorkerEntry] = {}
+        self._idle: List[bytes] = []
+        self._spawn_reserved = 0  # in-flight spawns counted against the cap
+        self._clients = ClientPool(name="node")
+        self._stopped = threading.Event()
+        self.server = RpcServer({
+            "lease_worker": self._h_lease_worker,
+            "return_worker": self._h_return_worker,
+            "start_actor": self._h_start_actor,
+            "kill_worker": self._h_kill_worker,
+            "worker_ready": self._h_worker_ready,
+            "read_object": self._h_read_object,
+            "delete_object": self._h_delete_object,
+            "store_stats": lambda p, c: self.store.stats(),
+            "list_workers": self._h_list_workers,
+            "ping": lambda p, c: "pong",
+            "shutdown": self._h_shutdown,
+        }, host=host, port=port, max_workers=32, name="node")
+        self.address = self.server.address
+        # register with head
+        self._clients.get(head_addr).call_retrying("register_node", {
+            "node_id": self.node_id, "address": self.address,
+            "shm_name": self.shm_name, "resources": self.resources,
+        })
+        for _ in range(cfg.worker_pool_prestart):
+            self._spawn_worker()
+
+    # ------------------------------------------------------------ worker pool
+
+    def _spawn_worker(self) -> _WorkerEntry:
+        worker_id = WorkerID.from_random().binary()
+        from ray_tpu.runtime.spawn import child_env
+        env = child_env({"RTPU_SESSION": self.session})
+        cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main",
+               self.address, self.head_addr, self.shm_name,
+               worker_id.hex(), config_mod.GlobalConfig.to_json()]
+        proc = subprocess.Popen(cmd, env=env)
+        entry = _WorkerEntry(worker_id, proc)
+        with self._lock:
+            self._workers[worker_id] = entry
+        threading.Thread(target=self._wait_worker, args=(entry,),
+                         daemon=True, name="node-waitpid").start()
+        return entry
+
+    def _wait_worker(self, entry: _WorkerEntry) -> None:
+        entry.proc.wait()
+        rc = entry.proc.returncode
+        with self._lock:
+            prev_state = entry.state
+            entry.state = "dead"
+            self._workers.pop(entry.worker_id, None)
+            if entry.worker_id in self._idle:
+                self._idle.remove(entry.worker_id)
+        entry.ready.set()
+        if self._stopped.is_set() or prev_state == "stopping":
+            return
+        try:
+            self._clients.get(self.head_addr).call("worker_died", {
+                "worker_id": entry.worker_id,
+                "node_id": self.node_id,
+                "reason": f"exit code {rc}",
+            })
+        except RpcError:
+            pass
+
+    def _h_worker_ready(self, p, ctx):
+        worker_id = p["worker_id"]
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None:
+                return False
+            entry.address = p["address"]
+            if entry.state == "starting":
+                entry.state = "idle"
+                self._idle.append(worker_id)
+        entry.ready.set()
+        return True
+
+    def _h_lease_worker(self, p, ctx):
+        """Pop an idle worker (spawning if under the cap); None = busy."""
+        cfg = config_mod.GlobalConfig
+        with self._lock:
+            while self._idle:
+                wid = self._idle.pop(0)
+                entry = self._workers.get(wid)
+                if entry is not None and entry.state == "idle":
+                    entry.state = "leased"
+                    return {"worker_id": wid, "worker_addr": entry.address}
+            # count in-flight spawns too — concurrent lease RPCs must not
+            # overshoot the pool cap between check and spawn
+            if len(self._workers) + self._spawn_reserved >= cfg.worker_pool_max:
+                return None
+            self._spawn_reserved += 1
+        try:
+            entry = self._spawn_worker()
+        finally:
+            with self._lock:
+                self._spawn_reserved -= 1
+        if not entry.ready.wait(timeout=cfg.rpc_connect_timeout_s * 3):
+            return None
+        with self._lock:
+            if entry.state in ("starting", "idle"):
+                if entry.worker_id in self._idle:
+                    self._idle.remove(entry.worker_id)
+                entry.state = "leased"
+                return {"worker_id": entry.worker_id,
+                        "worker_addr": entry.address}
+        return None
+
+    def _h_return_worker(self, p, ctx):
+        with self._lock:
+            entry = self._workers.get(p["worker_id"])
+            if entry is None or entry.state == "dead":
+                return False
+            entry.state = "idle"
+            if entry.worker_id not in self._idle:
+                self._idle.append(entry.worker_id)
+        return True
+
+    def _h_start_actor(self, p, ctx):
+        with self._lock:
+            entry = self._workers.get(p["worker_id"])
+        if entry is None or entry.address is None:
+            raise RpcError("worker gone before actor start")
+        with self._lock:
+            entry.state = "actor"
+            entry.actor_id = p.get("actor_id")
+        self._clients.get(entry.address).call("become_actor", {
+            "spec_bytes": p["spec_bytes"],
+            "num_restarts": p.get("num_restarts", 0),
+        })
+        return True
+
+    def _h_kill_worker(self, p, ctx):
+        with self._lock:
+            entry = self._workers.get(p["worker_id"])
+        if entry is None:
+            return False
+        entry.proc.kill()
+        return True
+
+    def _h_list_workers(self, p, ctx):
+        with self._lock:
+            return [{"worker_id": w.worker_id.hex(), "state": w.state,
+                     "address": w.address, "pid": w.proc.pid}
+                    for w in self._workers.values()]
+
+    # ----------------------------------------------------------- object plane
+
+    def _h_read_object(self, p, ctx):
+        """Serve an object's bytes to a remote node (pull path)."""
+        view = self.store.get(p["object_id"])
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.store.release(p["object_id"])
+
+    def _h_delete_object(self, p, ctx):
+        """Owner-initiated free of a primary copy: drop the creator pin
+        (held since create+seal — the primary-copy pin, reference: raylet
+        pins primary copies until the owner frees), then delete. If readers
+        still hold pins the store defers deletion to the last release."""
+        oid = p["object_id"]
+        self.store.release(oid)
+        return self.store.delete(oid)
+
+    # ------------------------------------------------------------------ admin
+
+    def _h_shutdown(self, p, ctx):
+        threading.Thread(target=self.stop, daemon=True).start()
+        return True
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.state = "stopping"
+            try:
+                w.proc.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 3.0
+        for w in workers:
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                w.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        try:
+            self._clients.get(self.head_addr).call(
+                "unregister_node", {"node_id": self.node_id}, timeout=2.0)
+        except RpcError:
+            pass
+        self.server.stop()
+        self._clients.close_all()
+        try:
+            self.store.unlink()
+        except Exception:
+            pass
+        self.store.close()
+
+
+def main() -> None:
+    """``python -m ray_tpu.runtime.node <head_addr> <session> <json_args>``"""
+    import signal
+
+    head_addr = sys.argv[1]
+    session = sys.argv[2]
+    args = json.loads(sys.argv[3])
+    if args.get("config"):
+        config_mod.GlobalConfig.apply(args["config"])
+    daemon = NodeDaemon(
+        head_addr, session,
+        resources=args.get("resources") or {"CPU": float(os.cpu_count() or 1)},
+        object_store_bytes=args.get("object_store_bytes"))
+    signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
+    print(f"RTPU_NODE_READY {daemon.address}", flush=True)
+    try:
+        while not daemon._stopped.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        daemon.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,364 @@
+"""Per-process object plane: shm store client + owner protocol + transfer.
+
+Combines the roles of the reference's CoreWorkerPlasmaStoreProvider
+(reference: src/ray/core_worker/store_provider/plasma_store_provider.h:88),
+the ownership-based object directory (object_manager/
+ownership_based_object_directory.h — owners are asked for locations), and
+the pull side of the object manager (object_manager/pull_manager.h:53 —
+remote objects are fetched from the node daemon holding them and cached in
+the local shm store).
+
+Placement policy (reference memory-store/plasma split,
+core_worker/store_provider/): serialized values <= memory_store_threshold
+stay in the owner's in-process memory store and travel inline over RPC;
+larger values are sealed into the node's shared-memory arena and move
+node-to-node at most once, then are mapped zero-copy by every local reader.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Set, Tuple
+
+from ray_tpu.core import config as config_mod
+from ray_tpu.core import serialization
+from ray_tpu.core._native import ObjectExists, ObjectStoreFull, ShmStore
+from ray_tpu.core.ids import ObjectID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.runtime.protocol import ClientPool, RpcError
+
+
+class ObjectPlane:
+    def __init__(self, worker, local_node_id: str, local_store: ShmStore,
+                 head_client, node_addrs: Dict[str, str],
+                 node_shm: Dict[str, str]):
+        self.worker = worker
+        self.local_node_id = local_node_id
+        self.store = local_store
+        self.head = head_client
+        self.node_addrs = dict(node_addrs)     # node_id -> daemon address
+        self.node_shm = dict(node_shm)         # node_id -> shm name
+        self.locations: Dict[ObjectID, str] = {}   # owned large obj -> node
+        self.owner_addrs: Dict[bytes, str] = {}    # worker_id -> rpc address
+        self.pinned: Set[bytes] = set()
+        self._peers = ClientPool(name="objplane")
+        self._fetching: Set[ObjectID] = set()
+        self._lock = threading.Lock()
+        # containment pins: owned object -> refs it contains (release on free)
+        self._contained: Dict[ObjectID, list] = {}
+
+    # ------------------------------------------------------------- directory
+
+    def refresh_nodes(self) -> None:
+        try:
+            for n in self.head.call("list_nodes"):
+                self.node_addrs[n["node_id"]] = n["address"]
+                self.node_shm[n["node_id"]] = n["shm_name"]
+        except RpcError:
+            pass
+
+    def node_client(self, node_id: str):
+        addr = self.node_addrs.get(node_id)
+        if addr is None:
+            self.refresh_nodes()
+            addr = self.node_addrs.get(node_id)
+            if addr is None:
+                raise ObjectLostError("", f"unknown node {node_id}")
+        return self._peers.get(addr)
+
+    def owner_client(self, owner: WorkerID):
+        key = owner.binary()
+        addr = self.owner_addrs.get(key)
+        if addr is None:
+            addr = self.head.call("kv_get", {"key": f"addr:{owner.hex()}"})
+            if addr is None:
+                raise ObjectLostError("", f"owner {owner.hex()[:12]} unknown")
+            self.owner_addrs[key] = addr
+        return self._peers.get(addr)
+
+    # ------------------------------------------------------------------- put
+
+    def put_object(self, object_id: ObjectID, value: Any,
+                   is_error: bool = False) -> None:
+        """Owner-side store: small -> memory store; large -> local shm."""
+        so = (serialization.serialize_error(value) if is_error
+              else serialization.serialize(value))
+        if so.contained_refs:
+            # Durable containment borrows replace the transient serialize-
+            # time pins (ObjectRef.__reduce__ fired on_ref_serialized).
+            self._register_contained(object_id, so.contained_refs)
+            for r in so.contained_refs:
+                self.worker.refcounter.on_serialized_ref_done(r.id())
+        cfg = config_mod.GlobalConfig
+        if so.total_bytes <= cfg.memory_store_threshold_bytes:
+            self.worker.memory_store.put(object_id, value, is_error=is_error)
+            return
+        self._seal_local(object_id, so)
+        self.locations[object_id] = self.local_node_id
+        self.worker.memory_store.mark_in_shm(object_id)
+
+    def _seal_local(self, object_id: ObjectID, so) -> None:
+        try:
+            buf = self.store.create_object(object_id.binary(), so.total_bytes)
+        except ObjectExists:
+            return
+        except ObjectStoreFull:
+            from ray_tpu.exceptions import ObjectStoreFullError
+            raise ObjectStoreFullError(
+                f"shm store full writing {so.total_bytes} bytes") from None
+        so.write_to(memoryview(buf).cast("B"))
+        self.store.seal(object_id.binary())
+
+    def store_result_bytes(self, object_id: ObjectID, data: bytes,
+                           pin: bool = True) -> str:
+        """Seal pre-serialized bytes into local shm.
+
+        ``pin=True`` keeps the creator pin (primary copy — freed by the
+        owner's delete path); ``pin=False`` releases it so the copy is an
+        LRU-evictable cache (secondary copies from pulls). Returns this
+        node's id (reported to the owner as the location).
+        """
+        try:
+            buf = self.store.create_object(object_id.binary(), len(data))
+            memoryview(buf).cast("B")[:] = data
+            self.store.seal(object_id.binary())
+            if not pin:
+                self.store.release(object_id.binary())
+        except ObjectExists:
+            pass
+        return self.local_node_id
+
+    def _register_contained(self, object_id: ObjectID, refs: list) -> None:
+        """An owned object embeds other refs: hold borrows until it's freed
+        (reference: ReferenceCounter nested-ref tracking,
+        reference_count.h:66)."""
+        with self._lock:
+            self._contained[object_id] = list(refs)
+        me = self.worker.worker_id.binary()
+        for r in refs:
+            if r.owner_id() == self.worker.worker_id:
+                self.worker.refcounter.add_borrower(r.id(), me)
+                continue
+            try:
+                self.owner_client(r.owner_id()).call(
+                    "add_borrower", {"object_id": r.id().binary(),
+                                     "borrower": me})
+            except (RpcError, ObjectLostError):
+                pass
+
+    # ------------------------------------------------------------------- get
+
+    def record_remote_location(self, object_id: ObjectID, node_id: str) -> None:
+        """Owner learns a return value was sealed on some node's shm."""
+        self.locations[object_id] = node_id
+        self.worker.memory_store.mark_in_shm(object_id)
+
+    def try_resolve(self, ref: ObjectRef) -> bool:
+        if self.worker.memory_store.is_ready(ref.id()):
+            return True
+        if self.store.contains(ref.id().binary()):
+            self.worker.memory_store.mark_in_shm(ref.id())
+            return True
+        return False
+
+    def poke_resolve(self, ref: ObjectRef) -> None:
+        """Start an async fetch loop for a ref we don't own locally."""
+        if self.try_resolve(ref):
+            return
+        if ref.owner_id() == self.worker.worker_id:
+            return  # we own it; the result will arrive via the reply path
+        with self._lock:
+            if ref.id() in self._fetching:
+                return
+            self._fetching.add(ref.id())
+        threading.Thread(target=self._fetch_loop, args=(ref,), daemon=True,
+                         name="objplane-fetch").start()
+
+    def _fetch_loop(self, ref: ObjectRef) -> None:
+        cfg = config_mod.GlobalConfig
+        retry_s = cfg.object_pull_retry_ms / 1000.0
+        failures = 0
+        try:
+            while True:
+                if self.try_resolve(ref):
+                    return
+                try:
+                    reply = self.owner_client(ref.owner_id()).call(
+                        "get_object", {"object_id": ref.id().binary()})
+                    failures = 0
+                except (RpcError, ObjectLostError):
+                    failures += 1
+                    if failures >= cfg.rpc_retry_max_attempts:
+                        self.worker.memory_store.put(
+                            ref.id(),
+                            ObjectLostError(ref.hex(), "owner unreachable"),
+                            is_error=True)
+                        return
+                    time.sleep(retry_s)
+                    continue
+                if reply is None:
+                    self.worker.memory_store.put(
+                        ref.id(),
+                        ObjectLostError(ref.hex(), "owner dropped the object"),
+                        is_error=True)
+                    return
+                if reply.get("pending"):
+                    time.sleep(retry_s)
+                    continue
+                if "inline" in reply:
+                    value = serialization.deserialize(reply["inline"])
+                    self.worker.memory_store.put(
+                        ref.id(), value, is_error=reply.get("is_error", False))
+                    return
+                if "shm" in reply:
+                    try:
+                        self._pull_to_local(ref.id(), reply["shm"])
+                    except (RpcError, ObjectLostError) as e:
+                        # holder node died mid-pull: surface the loss
+                        # instead of killing this thread (a silent death
+                        # leaves rt.get() hanging forever)
+                        self.worker.memory_store.put(
+                            ref.id(),
+                            ObjectLostError(ref.hex(), f"pull failed: {e}"),
+                            is_error=True)
+                        return
+                    self.worker.memory_store.mark_in_shm(ref.id())
+                    return
+        finally:
+            with self._lock:
+                self._fetching.discard(ref.id())
+
+    def _pull_to_local(self, object_id: ObjectID, node_id: str) -> None:
+        """Fetch a sealed object from a remote node into the local arena
+        (reference pull path: pull_manager.h:53 -> ObjectManager::Push).
+
+        The local copy is a *secondary* (cache) copy: the creator pin is
+        released right away so LRU eviction can reclaim it; the primary on
+        `node_id` stays pinned until the owner frees it."""
+        if node_id == self.local_node_id or \
+                self.store.contains(object_id.binary()):
+            return
+        data = self.node_client(node_id).call_retrying(
+            "read_object", {"object_id": object_id.binary()})
+        if data is None:
+            raise ObjectLostError(object_id.hex(), f"gone from {node_id}")
+        self.store_result_bytes(object_id, data, pin=False)
+
+    def get_from_store(self, ref: ObjectRef) -> Tuple[Any, bool]:
+        """Blocking read of a sealed object; pulls cross-node if needed.
+
+        The zero-copy view stays pinned until the object is freed locally
+        (reference: plasma client pin semantics).
+        """
+        oid = ref.id()
+        if not self.store.contains(oid.binary()):
+            node_id = self.locations.get(oid)
+            if node_id is None:
+                reply = self.owner_client(ref.owner_id()).call(
+                    "get_object", {"object_id": oid.binary()})
+                if not reply or "shm" not in reply:
+                    raise ObjectLostError(oid.hex(), "no longer in shm")
+                node_id = reply["shm"]
+            self._pull_to_local(oid, node_id)
+        view = self.store.get(oid.binary())
+        if view is None:
+            raise ObjectLostError(oid.hex(), "evicted from shm")
+        # store.get pins on every call; this process holds at most one
+        # logical read pin per object (released on free/unborrow), so drop
+        # duplicate pins from repeated gets of the same ref.
+        with self._lock:
+            if oid.binary() in self.pinned:
+                self.store.release(oid.binary())
+            else:
+                self.pinned.add(oid.binary())
+        value = serialization.deserialize(view)
+        return value, False
+
+    # -------------------------------------------------- owner service handlers
+
+    def handle_get_object(self, p, ctx):
+        oid = ObjectID(p["object_id"])
+        entry = self.worker.memory_store.get_if_ready(oid)
+        if entry is None:
+            # No value yet: either the producing task is still running
+            # (refcount still tracks the oid) or we already freed it —
+            # answer None so the borrower surfaces ObjectLostError instead
+            # of polling forever.
+            if not self.worker.refcounter.is_tracked(oid):
+                return None
+            return {"pending": True}
+        value, is_error, in_shm = entry
+        if in_shm:
+            return {"shm": self.locations.get(oid, self.local_node_id)}
+        so = (serialization.serialize_error(value) if is_error
+              else serialization.serialize(value))
+        data = so.to_bytes()
+        # undo the transient serialize-time pins on nested refs; the
+        # requester registers its own borrows when it deserializes
+        for r in so.contained_refs:
+            self.worker.refcounter.on_serialized_ref_done(r.id())
+        return {"inline": data, "is_error": is_error}
+
+    def handle_add_borrower(self, p, ctx):
+        self.worker.refcounter.add_borrower(
+            ObjectID(p["object_id"]), p["borrower"])
+        return True
+
+    def handle_remove_borrower(self, p, ctx):
+        self.worker.refcounter.remove_borrower(
+            ObjectID(p["object_id"]), p["borrower"])
+        return True
+
+    # ------------------------------------------------------------------ free
+
+    def free_object(self, object_id: ObjectID) -> None:
+        """Owner decided the object is garbage (refcount hit zero)."""
+        key = object_id.binary()
+        if key in self.pinned:
+            self.pinned.discard(key)
+            try:
+                self.store.release(key)
+            except OSError:
+                pass
+        node_id = self.locations.pop(object_id, None)
+        if node_id is not None:
+            try:
+                self.node_client(node_id).call(
+                    "delete_object", {"object_id": key}, timeout=5.0)
+            except (RpcError, ObjectLostError):
+                pass
+        with self._lock:
+            contained = self._contained.pop(object_id, [])
+        me = self.worker.worker_id.binary()
+        for r in contained:
+            if r.owner_id() == self.worker.worker_id:
+                self.worker.refcounter.remove_borrower(r.id(), me)
+                continue
+            try:
+                self.owner_client(r.owner_id()).call(
+                    "remove_borrower",
+                    {"object_id": r.id().binary(), "borrower": me})
+            except (RpcError, ObjectLostError):
+                pass
+
+    def release_local_pin(self, object_id: ObjectID) -> None:
+        """A borrowed shm object is no longer referenced in this process."""
+        key = object_id.binary()
+        if key in self.pinned:
+            self.pinned.discard(key)
+            try:
+                self.store.release(key)
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        for key in list(self.pinned):
+            try:
+                self.store.release(key)
+            except OSError:
+                pass
+        self.pinned.clear()
+        self._peers.close_all()
+        self.store.close()

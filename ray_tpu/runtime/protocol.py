@@ -1,0 +1,475 @@
+"""Framed RPC over TCP: request-id multiplexing, retries, chaos injection.
+
+Role-equivalent to the reference's rpc layer (reference:
+src/ray/rpc/grpc_server.h, grpc_client.h, retryable_grpc_client.h): typed
+async calls over persistent connections. Design differences are deliberate:
+instead of gRPC streams we frame pickled dicts over a TCP socket with a
+request-id so many calls pipeline over one connection (the property that
+makes lease/push pipelining and 8k tasks/s possible in the reference);
+replies may be deferred by the handler (actor queues reply on completion).
+
+Chaos injection mirrors reference src/ray/rpc/rpc_chaos.h:23
+(RAY_testing_rpc_failure): config `testing_rpc_failure="method=N[,m=N]"`
+fails the first N client calls of that method with RpcError so retry paths
+are testable without real network faults.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.core import config as config_mod
+
+_FRAME = struct.Struct("<QQ")  # (request_id, payload_len); id 0 = oneway
+
+# request ids with the high bit set are replies
+_REPLY_BIT = 1 << 63
+
+
+class RpcError(Exception):
+    """Transport-level failure (connect refused, peer died, chaos)."""
+
+
+class ChaosInjectedError(RpcError):
+    pass
+
+
+def _chaos_table() -> Dict[str, int]:
+    raw = config_mod.GlobalConfig.testing_rpc_failure
+    table: Dict[str, int] = {}
+    if raw:
+        for part in raw.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                table[k.strip()] = int(v)
+    return table
+
+
+class _ChaosState:
+    """Per-process count of injected failures, keyed by method."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._injected: Dict[str, int] = {}
+
+    def should_fail(self, method: str) -> bool:
+        budget = _chaos_table().get(method)
+        if not budget:
+            return False
+        with self._lock:
+            used = self._injected.get(method, 0)
+            if used >= budget:
+                return False
+            self._injected[method] = used + 1
+            return True
+
+
+_chaos = _ChaosState()
+
+
+def reset_chaos() -> None:
+    global _chaos
+    _chaos = _ChaosState()
+
+
+# ---------------------------------------------------------------------------
+# framing helpers
+
+def _send_frame(sock: socket.socket, req_id: int, payload: bytes,
+                lock: threading.Lock) -> None:
+    header = _FRAME.pack(req_id, len(payload))
+    with lock:
+        sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    header = _recv_exact(sock, _FRAME.size)
+    req_id, length = _FRAME.unpack(header)
+    return req_id, _recv_exact(sock, length)
+
+
+# ---------------------------------------------------------------------------
+# server
+
+class HandlerContext:
+    """Passed to every handler; allows deferred replies and peer identity."""
+
+    __slots__ = ("_conn", "_req_id", "peer", "replied")
+
+    def __init__(self, conn: "_ServerConn", req_id: int):
+        self._conn = conn
+        self._req_id = req_id
+        self.peer = conn.peer
+        self.replied = False
+
+    def reply(self, value: Any = None, error: Optional[BaseException] = None) -> None:
+        if self.replied:
+            return
+        self.replied = True
+        self._conn.send_reply(self._req_id, value, error)
+
+
+DEFERRED = object()  # handler sentinel: "I'll call ctx.reply() later"
+
+
+class _ServerConn:
+    def __init__(self, server: "RpcServer", sock: socket.socket, peer):
+        self.server = server
+        self.sock = sock
+        self.peer = peer
+        self.wlock = threading.Lock()
+        self.alive = True
+
+    def send_reply(self, req_id: int, value: Any, error: Optional[BaseException]) -> None:
+        if req_id == 0:  # oneway — no reply expected
+            return
+        try:
+            payload = pickle.dumps((value, error), protocol=5)
+        except Exception as e:  # unpicklable result
+            payload = pickle.dumps((None, RpcError(f"unpicklable reply: {e!r}")),
+                                   protocol=5)
+        try:
+            _send_frame(self.sock, req_id | _REPLY_BIT, payload, self.wlock)
+        except OSError:
+            self.alive = False
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RpcServer:
+    """Threaded RPC server. Handlers: dict method -> fn(payload, ctx).
+
+    A handler returns a value (replied immediately), raises (error reply),
+    or returns DEFERRED and calls ctx.reply() later from any thread.
+    """
+
+    def __init__(self, handlers: Dict[str, Callable[[Any, HandlerContext], Any]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 16, name: str = "rpc",
+                 inline_methods: Optional[set] = None):
+        self.handlers = dict(handlers)
+        # Methods run inline on the connection reader thread instead of the
+        # pool: preserves per-connection arrival order (actor task queues —
+        # reference: ActorSchedulingQueue seq ordering). Must be fast and
+        # non-blocking (enqueue + DEFERRED).
+        self.inline_methods = set(inline_methods or ())
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(512)
+        self.host, self.port = self._sock.getsockname()
+        self.address = f"{self.host}:{self.port}"
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix=f"{name}-h")
+        self._conns: list[_ServerConn] = []
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self.on_disconnect: Optional[Callable[[Any], None]] = None
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"{name}-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, peer = self._sock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _ServerConn(self, sock, peer)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: _ServerConn) -> None:
+        try:
+            while not self._stopped.is_set():
+                req_id, payload = _recv_frame(conn.sock)
+                if self.inline_methods:
+                    # decode once on the reader thread; inline methods run
+                    # here (per-connection FIFO), the rest go to the pool
+                    # with the already-decoded message
+                    try:
+                        msg = pickle.loads(payload)
+                    except BaseException as e:  # noqa: BLE001
+                        HandlerContext(conn, req_id).reply(
+                            None, error=RpcError(f"bad request: {e!r}"))
+                        continue
+                    if msg[0] in self.inline_methods:
+                        self._dispatch_decoded(conn, req_id, msg)
+                    else:
+                        self._pool.submit(
+                            self._dispatch_decoded, conn, req_id, msg)
+                else:
+                    self._pool.submit(self._dispatch, conn, req_id, payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.alive = False
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            if self.on_disconnect is not None:
+                try:
+                    self.on_disconnect(conn.peer)
+                except Exception:
+                    pass
+
+    def _dispatch(self, conn: _ServerConn, req_id: int, payload: bytes) -> None:
+        ctx = HandlerContext(conn, req_id)
+        try:
+            msg = pickle.loads(payload)
+        except BaseException as e:  # noqa: BLE001
+            ctx.reply(None, error=RpcError(f"bad request: {e!r}"))
+            return
+        self._dispatch_decoded(conn, req_id, msg, ctx)
+
+    def _dispatch_decoded(self, conn: _ServerConn, req_id: int, msg,
+                          ctx: Optional[HandlerContext] = None) -> None:
+        if ctx is None:
+            ctx = HandlerContext(conn, req_id)
+        try:
+            method, body = msg
+            handler = self.handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no handler for {method!r}")
+            result = handler(body, ctx)
+            if result is DEFERRED:
+                return
+            ctx.reply(result)
+        except BaseException as e:  # noqa: BLE001
+            ctx.reply(None, error=e)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+# ---------------------------------------------------------------------------
+# client
+
+class RpcClient:
+    """Persistent-connection client with request multiplexing and retries.
+
+    One reader thread resolves reply futures; callers block on their own
+    future, so arbitrarily many calls pipeline over the single connection
+    (the async-gRPC property the reference relies on).
+    """
+
+    def __init__(self, address: str, name: str = "client"):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._name = name
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._closed = False
+
+    # -- connection management --
+
+    def _connect(self) -> socket.socket:
+        with self._conn_lock:
+            if self._sock is not None:
+                return self._sock
+            if self._closed:
+                raise RpcError("client closed")
+            cfg = config_mod.GlobalConfig
+            sock = socket.create_connection(
+                (self._host, self._port), timeout=cfg.rpc_connect_timeout_s)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+            threading.Thread(target=self._reader_loop, args=(sock,),
+                             daemon=True, name=f"{self._name}-rd").start()
+            return sock
+
+    def _reader_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                req_id, payload = _recv_frame(sock)
+                req_id &= ~_REPLY_BIT
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is None:
+                    continue
+                try:
+                    value, error = pickle.loads(payload)
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(RpcError(f"bad reply: {e!r}"))
+                    continue
+                if error is not None:
+                    fut.set_exception(error)
+                else:
+                    fut.set_result(value)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._fail_all(RpcError(f"connection to {self.address} lost"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._conn_lock:
+            self._sock = None
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    # -- calls --
+
+    def call_async(self, method: str, payload: Any = None) -> Future:
+        fut: Future = Future()
+        if _chaos.should_fail(method):
+            fut.set_exception(ChaosInjectedError(f"chaos: {method}"))
+            return fut
+        cfg = config_mod.GlobalConfig
+        if cfg.testing_rpc_delay_ms:
+            time.sleep(cfg.testing_rpc_delay_ms / 1000.0)
+        with self._id_lock:
+            self._next_id += 1
+            req_id = self._next_id
+        fut._rtpu_req_id = req_id  # lets call() reap on timeout
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        try:
+            sock = self._connect()
+            data = pickle.dumps((method, payload), protocol=5)
+            _send_frame(sock, req_id, data, self._wlock)
+        except BaseException as e:  # noqa: BLE001
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            if not fut.done():
+                fut.set_exception(
+                    e if isinstance(e, RpcError) else RpcError(repr(e)))
+        return fut
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        cfg = config_mod.GlobalConfig
+        if timeout is None:
+            timeout = cfg.rpc_call_timeout_s
+        fut = self.call_async(method, payload)
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            # drop the abandoned future so pending entries don't accumulate
+            # against a peer that never replies
+            req_id = getattr(fut, "_rtpu_req_id", None)
+            if req_id is not None:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+            raise RpcError(f"call {method} to {self.address} timed out "
+                           f"after {timeout}s") from None
+
+    def call_retrying(self, method: str, payload: Any = None,
+                      timeout: Optional[float] = None) -> Any:
+        """Retry with exponential backoff on transport failures only.
+
+        Mirrors reference retryable_grpc_client.h: application exceptions
+        pass through; RpcError (connect/chaos/conn-lost) retries.
+        """
+        cfg = config_mod.GlobalConfig
+        attempts = max(1, cfg.rpc_retry_max_attempts)
+        delay = cfg.rpc_retry_base_ms / 1000.0
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            try:
+                return self.call(method, payload, timeout=timeout)
+            except RpcError as e:
+                last = e
+                if i + 1 < attempts:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 5.0)
+        raise last  # type: ignore[misc]
+
+    def oneway(self, method: str, payload: Any = None) -> None:
+        """Fire-and-forget (no reply frame will come back)."""
+        if _chaos.should_fail(method):
+            return
+        try:
+            sock = self._connect()
+            data = pickle.dumps((method, payload), protocol=5)
+            _send_frame(sock, 0, data, self._wlock)
+        except BaseException:  # noqa: BLE001
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        with self._conn_lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._fail_all(RpcError("client closed"))
+
+
+class ClientPool:
+    """Address -> RpcClient cache (one persistent connection per peer)."""
+
+    def __init__(self, name: str = "pool"):
+        self._name = name
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(address)
+            if c is None:
+                c = RpcClient(address, name=self._name)
+                self._clients[address] = c
+            return c
+
+    def invalidate(self, address: str) -> None:
+        with self._lock:
+            c = self._clients.pop(address, None)
+        if c is not None:
+            c.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()

@@ -1,0 +1,255 @@
+"""Worker process — task execution loop + actor mode.
+
+Role-equivalent to the reference's worker-side CoreWorker (reference:
+src/ray/core_worker/core_worker.cc:3230 ExecuteTask, :3804 HandlePushTask;
+ordered actor queues in transport/task_receiver.h:51): a leased worker
+receives pushed tasks directly from the submitting owner over RPC, executes
+them serially (or on `max_concurrency` threads for threaded actors), and
+replies with results — small values inline, large values sealed into the
+node's shm store with the location reported back to the owner.
+
+The worker also runs the full client runtime (ClusterBackend), so task code
+can itself submit tasks, create actors, and put/get objects (nested
+remote calls — reference: workers are full CoreWorkers too).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.core import config as config_mod
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import TaskCancelledError, TaskError
+from ray_tpu.runtime import wire
+from ray_tpu.runtime.protocol import DEFERRED, RpcClient, RpcError
+
+
+class Executor:
+    """Serial (or n-threaded) execution of pushed tasks."""
+
+    def __init__(self, backend, worker):
+        self.backend = backend
+        self.worker = worker
+        self.queue: "queue.Queue" = queue.Queue()
+        self.fn_cache: Dict[str, Any] = {}
+        self.cancelled: set = set()
+        self.actor_instance: Optional[Any] = None
+        self.actor_id: Optional[bytes] = None
+        self._threads: List[threading.Thread] = []
+        self._start_threads(1)
+
+    def _start_threads(self, n: int) -> None:
+        while len(self._threads) < n:
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name=f"exec-{len(self._threads)}")
+            t.start()
+            self._threads.append(t)
+
+    # ------------------------------------------------------------- handlers
+
+    def handle_push_task(self, payload, ctx):
+        self.queue.put((payload, ctx))
+        return DEFERRED
+
+    def handle_cancel(self, payload, ctx):
+        self.cancelled.add(payload["task_id"])
+        return True
+
+    def handle_become_actor(self, payload, ctx):
+        # Ack immediately — construction runs async on the exec thread so an
+        # arbitrarily slow __init__ can't trip the node->worker RPC deadline
+        # (liveness is tracked via actor_ready/actor_failed to the head).
+        self.queue.put((("__become_actor__", payload), None))
+        return True
+
+    # ------------------------------------------------------------ execution
+
+    def _loop(self) -> None:
+        while True:
+            item, ctx = self.queue.get()
+            try:
+                if isinstance(item, tuple) and item and \
+                        item[0] == "__become_actor__":
+                    self._become_actor(item[1], ctx)
+                else:
+                    self._execute(item, ctx)
+            except BaseException as e:  # noqa: BLE001
+                try:
+                    if ctx is not None:
+                        ctx.reply(None, error=e)
+                except Exception:
+                    pass
+
+    def _resolve_function(self, key: str):
+        fn = self.fn_cache.get(key)
+        if fn is None:
+            blob = self.backend.head.call_retrying("kv_get", {"key": key})
+            if blob is None:
+                raise TaskError("LookupError", f"function {key} not exported",
+                                "<head kv miss>")
+            fn = cloudpickle.loads(blob)
+            self.fn_cache[key] = fn
+        return fn
+
+    def _resolve_args(self, wire_args: List[dict], kwargs_blob: bytes):
+        args = []
+        for a in wire_args:
+            if "ref" in a:
+                oid, owner = a["ref"]
+                ref = ObjectRef(ObjectID(oid), WorkerID(owner))
+                args.append(self.worker.get(ref))
+            else:
+                args.append(serialization.deserialize(a["inline"]))
+        kwargs = serialization.deserialize(kwargs_blob)
+        return args, kwargs
+
+    def _become_actor(self, payload: dict, ctx) -> None:
+        spec = pickle_loads(payload["spec_bytes"])
+        self.actor_id = spec["actor_id"]
+        num_restarts = payload.get("num_restarts", 0)
+        try:
+            cls = cloudpickle.loads(spec["cls_bytes"])
+            args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
+            if spec.get("max_concurrency", 1) > 1:
+                self._start_threads(spec["max_concurrency"])
+            self.actor_instance = cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            try:
+                self.backend.head.call("actor_failed", {
+                    "actor_id": spec["actor_id"],
+                    "num_restarts": num_restarts,
+                    "reason": f"{type(e).__name__}: {e}\n{tb}"})
+            except RpcError:
+                pass
+            return
+        try:
+            self.backend.head.call("actor_ready", {
+                "actor_id": spec["actor_id"],
+                "num_restarts": num_restarts,
+                "address": self.backend.server.address})
+        except RpcError:
+            pass
+
+    def _execute(self, payload: dict, ctx) -> None:
+        task_id = payload["task_id"]
+        if task_id in self.cancelled:
+            ctx.reply({"results": None, "cancelled": True})
+            return
+        num_returns = payload["num_returns"]
+        self.worker.current_task_id = TaskID(task_id)
+        try:
+            args, kwargs = self._resolve_args(payload["args"],
+                                              payload["kwargs"])
+            if payload.get("actor_id") is not None:
+                if self.actor_instance is None:
+                    raise RuntimeError("push to non-actor worker")
+                method = getattr(self.actor_instance, payload["method_name"],
+                                 None)
+                if method is None:
+                    raise AttributeError(
+                        f"actor has no method {payload['method_name']!r}")
+                result = method(*args, **kwargs)
+            else:
+                fn = self._resolve_function(payload["function_key"])
+                result = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, (SystemExit, KeyboardInterrupt)):
+                raise
+            so = serialization.serialize_error(e)
+            ctx.reply({"results": [{"inline": so.to_bytes(),
+                                    "is_error": True}] * num_returns})
+            return
+        finally:
+            self.worker.current_task_id = None
+        # package results
+        if num_returns == 1:
+            values = [result]
+        else:
+            if not isinstance(result, tuple) or len(result) != num_returns:
+                so = serialization.serialize_error(ValueError(
+                    f"declared num_returns={num_returns} but returned "
+                    f"{type(result)}"))
+                ctx.reply({"results": [{"inline": so.to_bytes(),
+                                        "is_error": True}] * num_returns})
+                return
+            values = list(result)
+        cfg = config_mod.GlobalConfig
+        results = []
+        contained = []
+        tid = TaskID(task_id)
+        for i, v in enumerate(values):
+            so = serialization.serialize(v)
+            contained.extend(so.contained_refs)
+            if so.total_bytes <= cfg.memory_store_threshold_bytes:
+                results.append({"inline": so.to_bytes(), "is_error": False})
+            else:
+                oid = ObjectID.for_return(tid, i + 1)
+                node = self.backend.object_plane.store_result_bytes(
+                    oid, so.to_bytes())
+                results.append({"in_shm": node})
+        ctx.reply({"results": results})
+        # undo transient serialize-time pins on refs nested in results; the
+        # owner registers its own borrows when it deserializes the reply
+        for r in contained:
+            self.worker.refcounter.on_serialized_ref_done(r.id())
+
+
+def pickle_loads(data: bytes):
+    import pickle
+    return pickle.loads(data)
+
+
+def main() -> None:
+    node_addr, head_addr, shm_name, worker_hex, cfg_json = sys.argv[1:6]
+    config_mod.GlobalConfig.apply(json.loads(cfg_json))
+
+    # Die with the node daemon (reference: raylet owns worker lifetimes —
+    # node death must kill its workers or "node failure" tests lie).
+    try:
+        import ctypes
+        import signal
+        PR_SET_PDEATHSIG = 1
+        ctypes.CDLL("libc.so.6", use_errno=True).prctl(
+            PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:
+        pass
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.runtime.cluster_backend import ClusterBackend
+
+    worker_id = WorkerID(bytes.fromhex(worker_hex))
+    backend = ClusterBackend.connect_as_worker(
+        global_worker, head_addr, shm_name, worker_id)
+    executor = Executor(backend, global_worker)
+    backend.server.handlers.update({
+        "push_task": executor.handle_push_task,
+        "become_actor": executor.handle_become_actor,
+        "cancel_task": executor.handle_cancel,
+        "ping": lambda p, c: "pong",
+        "exit": lambda p, c: os._exit(0),
+    })
+    backend.server.inline_methods.add("push_task")
+
+    node = RpcClient(node_addr, name="worker->node")
+    node.call_retrying("worker_ready", {
+        "worker_id": worker_id.binary(),
+        "address": backend.server.address,
+    })
+    # park forever; the node daemon owns our lifetime
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()
