@@ -1,0 +1,5 @@
+"""Accelerator managers (reference: python/ray/_private/accelerators/)."""
+
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+__all__ = ["TPUAcceleratorManager"]

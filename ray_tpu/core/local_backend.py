@@ -222,6 +222,32 @@ class LocalBackend:
     def cancel_task(self, ref: ObjectRef, force: bool) -> None:
         self.cancelled.add(ref.id().task_id())
 
+    # ------------------------------------------------------ placement groups
+    # Local mode: reservations are bookkeeping only (one in-process "node");
+    # a PG is CREATED iff each bundle fits the node's total resources.
+
+    def create_placement_group(self, pg_id: bytes, bundles: list,
+                               strategy: str, name: str = "") -> None:
+        feasible = all(
+            all(self.resources.get(k, 0.0) >= v for k, v in b.items())
+            for b in bundles)
+        with self._lock:
+            if not hasattr(self, "_pgs"):
+                self._pgs: Dict[bytes, dict] = {}
+            self._pgs[pg_id] = {
+                "bundles": bundles, "strategy": strategy, "name": name,
+                "state": "CREATED" if feasible else "INFEASIBLE",
+                "nodes": ["local"] * len(bundles) if feasible else None}
+
+    def remove_placement_group(self, pg_id: bytes) -> bool:
+        with self._lock:
+            return getattr(self, "_pgs", {}).pop(pg_id, None) is not None
+
+    def get_placement_group(self, pg_id: bytes):
+        with self._lock:
+            pg = getattr(self, "_pgs", {}).get(pg_id)
+            return dict(pg) if pg else None
+
     # ----------------------------------------------------------------- misc
 
     def cluster_resources(self) -> Dict[str, float]:

@@ -72,10 +72,12 @@ class _TaskSubmitter:
     """Lease-cached pipelined submission for one resource shape."""
 
     def __init__(self, backend: "ClusterBackend", shape_key: tuple,
-                 resources: Dict[str, float]):
+                 resources: Dict[str, float],
+                 pg: Optional[Tuple[bytes, int]] = None):
         self.backend = backend
         self.shape_key = shape_key
         self.resources = resources
+        self.pg = pg
         self.pending: collections.deque = collections.deque()
         self.leases: Dict[str, _Lease] = {}
         self.requesting = 0
@@ -133,9 +135,12 @@ class _TaskSubmitter:
                 with self.lock:
                     if not self.pending:
                         return
+                payload = {"resources": self.resources}
+                if self.pg is not None:
+                    payload["pg_id"], payload["bundle_index"] = self.pg
                 try:
                     grant = self.backend.head.call_retrying(
-                        "request_lease", {"resources": self.resources})
+                        "request_lease", payload)
                 except RpcError:
                     time.sleep(0.2)
                     continue
@@ -564,11 +569,15 @@ class ClusterBackend:
         key = self._export_function(spec.function)
         payload, contained = wire.task_to_wire(spec, function_key=key)
         pins = self._pin_args(spec, contained)
-        shape_key = tuple(sorted(spec.resources.items()))
+        pg = None
+        if spec.placement_group_id is not None:
+            pg = (spec.placement_group_id, spec.placement_bundle_index)
+        shape_key = (tuple(sorted(spec.resources.items())), pg)
         with self._lock:
             sub = self._submitters.get(shape_key)
             if sub is None:
-                sub = _TaskSubmitter(self, shape_key, dict(spec.resources))
+                sub = _TaskSubmitter(self, shape_key, dict(spec.resources),
+                                     pg=pg)
                 self._submitters[shape_key] = sub
         sub.submit(payload, spec, pins)
 
@@ -636,6 +645,8 @@ class ClusterBackend:
             "resources": spec.resources,
             "owner_addr": self.server.address,
             "class_name": spec.name,
+            "pg_id": spec.placement_group_id,
+            "bundle_index": spec.placement_bundle_index,
         })
         with self._lock:
             self._actor_submitters[spec.actor_id] = _ActorSubmitter(
@@ -665,6 +676,22 @@ class ClusterBackend:
             registered_name=name, namespace=namespace,
             max_task_retries=info["max_task_retries"])
         return spec
+
+    # ------------------------------------------------------ placement groups
+
+    def create_placement_group(self, pg_id: bytes, bundles: list,
+                               strategy: str, name: str = "") -> None:
+        self.head.call_retrying("create_placement_group", {
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+            "name": name})
+
+    def remove_placement_group(self, pg_id: bytes) -> bool:
+        return self.head.call_retrying("remove_placement_group",
+                                       {"pg_id": pg_id})
+
+    def get_placement_group(self, pg_id: bytes):
+        return self.head.call_retrying("get_placement_group",
+                                       {"pg_id": pg_id})
 
     # ------------------------------------------------------------------ misc
 

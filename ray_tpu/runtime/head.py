@@ -43,6 +43,21 @@ _POLICY_BY_NAME = {
 }
 
 
+def _bundle_fits(pg: dict, idx: int, resources: Dict[str, float]) -> bool:
+    """Caller holds the head lock. True if `resources` fit what remains of
+    bundle `idx` after current draws."""
+    bundle = pg["bundles"][idx]
+    used = pg.setdefault("used", [dict() for _ in pg["bundles"]])[idx]
+    return all(used.get(k, 0.0) + v <= bundle.get(k, 0.0) + 1e-9
+               for k, v in resources.items())
+
+
+def _bundle_draw(pg: dict, idx: int, resources: Dict[str, float]) -> None:
+    used = pg.setdefault("used", [dict() for _ in pg["bundles"]])[idx]
+    for k, v in resources.items():
+        used[k] = used.get(k, 0.0) + v
+
+
 class _NodeEntry:
     __slots__ = ("node_id", "address", "shm_name", "resources", "alive",
                  "last_seen", "missed")
@@ -62,7 +77,7 @@ class _ActorEntry:
     __slots__ = ("actor_id", "spec_bytes", "state", "address", "node_id",
                  "worker_id", "restarts_left", "max_task_retries", "reason",
                  "name_key", "resources", "owner_addr", "class_name",
-                 "num_restarts")
+                 "num_restarts", "pg", "lease_resources", "pg_drawn_bundle")
 
     def __init__(self, actor_id: bytes, spec_bytes: bytes, restarts_left: int,
                  max_task_retries: int, name_key: str,
@@ -82,14 +97,21 @@ class _ActorEntry:
         self.owner_addr = owner_addr
         self.class_name = class_name
         self.num_restarts = 0
+        self.pg = None  # (pg_id, bundle_index) when PG-scheduled
+        # physical shape for the node lease (chip env etc.); differs from
+        # `resources` for PG actors, whose cluster accounting lives in the
+        # bundle reservation
+        self.lease_resources = dict(resources)
+        self.pg_drawn_bundle: Optional[int] = None
 
 
 class _LeaseEntry:
     __slots__ = ("lease_id", "node_id", "worker_id", "worker_addr",
-                 "resources", "created", "peer")
+                 "resources", "created", "peer", "pg_id", "bundle_index")
 
     def __init__(self, lease_id: str, node_id: str, worker_id: bytes,
-                 worker_addr: str, resources: Dict[str, float], peer):
+                 worker_addr: str, resources: Dict[str, float], peer,
+                 pg_id: Optional[bytes] = None, bundle_index: int = -1):
         self.lease_id = lease_id
         self.node_id = node_id
         self.worker_id = worker_id
@@ -97,6 +119,8 @@ class _LeaseEntry:
         self.resources = resources
         self.created = time.monotonic()
         self.peer = peer  # requesting connection; leases die with it
+        self.pg_id = pg_id
+        self.bundle_index = bundle_index
 
 
 class Head:
@@ -231,8 +255,16 @@ class Head:
         Reply: {lease_id, node_id, worker_id, worker_addr, shm_name} or
         {retry: True} when resources are busy, or {infeasible: True} when no
         node could ever satisfy the shape.
+
+        With pg_id set, the lease comes from the bundle's reserved node and
+        no extra resources are acquired — the PG already holds them
+        (reference: PlacementGroupSchedulingStrategy +
+        placement_group_resource_manager.h bundle accounting).
         """
         resources = p["resources"]
+        pg_id = p.get("pg_id")
+        if pg_id is not None:
+            return self._pg_lease(p, pg_id, ctx)
         node_id = self._schedule_and_acquire(
             resources, policy=p.get("policy", "hybrid"),
             affinity_node=p.get("affinity_node", ""),
@@ -253,9 +285,15 @@ class Head:
             self._release(node_id, resources)
             self._mark_node_dead(node_id, f"lease rpc failed: {e}")
             return {"retry": True}
+        except Exception as e:  # node-side bug: don't leak the acquisition
+            self._release(node_id, resources)
+            return {"infeasible": True, "reason": f"lease failed: {e}"}
         if grant is None:
             self._release(node_id, resources)
             return {"retry": True}
+        if isinstance(grant, dict) and "invalid" in grant:
+            self._release(node_id, resources)
+            return {"infeasible": True, "reason": grant["invalid"]}
         with self._lock:
             self._lease_counter += 1
             lease_id = f"l{self._lease_counter}"
@@ -266,6 +304,74 @@ class Head:
                 "worker_id": grant["worker_id"],
                 "worker_addr": grant["worker_addr"],
                 "shm_name": node.shm_name}
+
+    def _pg_lease(self, p, pg_id: bytes, ctx=None):
+        resources = p["resources"]
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return {"infeasible": True, "retry": False,
+                        "reason": "placement group removed"}
+            if pg["state"] != "CREATED":
+                return {"retry": True}
+            idx = p.get("bundle_index", -1)
+            if idx >= len(pg["bundles"]):
+                return {"infeasible": True,
+                        "reason": f"bundle index {idx} out of range "
+                                  f"({len(pg['bundles'])} bundles)"}
+            # per-bundle usage accounting: a lease draws down its bundle's
+            # reservation so concurrent tasks can't overrun into another
+            # PG's chips (reference: placement_group_resource_manager.h)
+            if idx < 0:
+                idx = next((i for i in range(len(pg["bundles"]))
+                            if _bundle_fits(pg, i, resources)), -1)
+                if idx < 0:
+                    return {"retry": True}
+            elif not _bundle_fits(pg, idx, resources):
+                return {"retry": True}
+            _bundle_draw(pg, idx, resources)
+            node_id = pg["nodes"][idx]
+            node = self._nodes.get(node_id)
+        if node is None or not node.alive:
+            self._bundle_return(pg_id, idx, resources)
+            return {"retry": True}
+        try:
+            grant = self._node_clients.get(node.address).call(
+                "lease_worker", {"resources": resources})
+        except RpcError as e:
+            self._bundle_return(pg_id, idx, resources)
+            self._mark_node_dead(node_id, f"lease rpc failed: {e}")
+            return {"retry": True}
+        except Exception as e:
+            self._bundle_return(pg_id, idx, resources)
+            return {"infeasible": True, "reason": f"lease failed: {e}"}
+        if grant is None:
+            self._bundle_return(pg_id, idx, resources)
+            return {"retry": True}
+        if isinstance(grant, dict) and "invalid" in grant:
+            self._bundle_return(pg_id, idx, resources)
+            return {"infeasible": True, "reason": grant["invalid"]}
+        with self._lock:
+            self._lease_counter += 1
+            lease_id = f"l{self._lease_counter}"
+            # resources recorded for bundle return, not cluster release
+            self._leases[lease_id] = _LeaseEntry(
+                lease_id, node_id, grant["worker_id"], grant["worker_addr"],
+                resources, ctx.peer if ctx is not None else None,
+                pg_id=pg_id, bundle_index=idx)
+        return {"lease_id": lease_id, "node_id": node_id,
+                "worker_id": grant["worker_id"],
+                "worker_addr": grant["worker_addr"],
+                "shm_name": node.shm_name}
+
+    def _bundle_return(self, pg_id: bytes, idx: int,
+                       resources: Dict[str, float]) -> None:
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is not None and pg.get("used"):
+                used = pg["used"][idx]
+                for k, v in resources.items():
+                    used[k] = max(0.0, used.get(k, 0.0) - v)
 
     def _on_client_disconnect(self, peer) -> None:
         with self._lock:
@@ -279,7 +385,11 @@ class Head:
             lease = self._leases.pop(p["lease_id"], None)
         if lease is None:
             return False
-        self._release(lease.node_id, lease.resources)
+        if lease.pg_id is not None:
+            self._bundle_return(lease.pg_id, lease.bundle_index,
+                                lease.resources)
+        else:
+            self._release(lease.node_id, lease.resources)
         node = self._nodes.get(lease.node_id)
         if node is not None and node.alive:
             try:
@@ -303,6 +413,12 @@ class Head:
             actor_id, p["spec_bytes"], p["max_restarts"],
             p["max_task_retries"], p.get("name_key", ""),
             p["resources"], p.get("owner_addr", ""), p.get("class_name", ""))
+        if p.get("pg_id") is not None:
+            # bundle reservations cover the cluster accounting; the node
+            # lease still carries the physical shape (lease_resources) so
+            # TPU actors get chip allocation + TPU_VISIBLE_CHIPS
+            entry.pg = (p["pg_id"], p.get("bundle_index", -1))
+            entry.resources = {}
         with self._lock:
             if entry.name_key:
                 if entry.name_key in self._named:
@@ -322,7 +438,13 @@ class Head:
                 with self._lock:
                     if entry.state == DEAD:
                         return  # killed while pending placement
-                node_id = self._schedule_and_acquire(entry.resources)
+                if entry.pg is not None:
+                    node_id = self._pg_actor_node(entry)
+                    if node_id is None:
+                        time.sleep(0.02)
+                        continue
+                else:
+                    node_id = self._schedule_and_acquire(entry.resources)
                 if node_id is not None:
                     node = self._nodes[node_id]
                     try:
@@ -383,6 +505,24 @@ class Head:
 
         threading.Thread(target=_try_place, daemon=True,
                          name="head-actor-place").start()
+
+    def _pg_actor_node(self, entry: _ActorEntry) -> Optional[str]:
+        """Bundle's node for a PG-scheduled actor; None while the PG is
+        pending. Marks the actor DEAD if its PG was removed."""
+        pg_id, idx = entry.pg
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                entry.state = DEAD
+                entry.reason = "placement group removed"
+                return None
+            if pg["state"] != "CREATED":
+                return None
+            node_id = pg["nodes"][idx if idx >= 0 else 0]
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return None
+            return node_id
 
     def _h_actor_ready(self, p, ctx):
         with self._lock:
@@ -549,29 +689,48 @@ class Head:
                     n.missed += 1
                     if n.missed >= max_missed:
                         self._mark_node_dead(n.node_id, "health check failed")
+            # periodic retry of pending placement groups: resources freed
+            # by finished leases/actors may now fit a queued reservation
+            self._try_schedule_pgs()
 
     # ------------------------------------------------------- placement groups
 
     def _h_create_pg(self, p, ctx):
-        """All-or-nothing bundle reservation (reference:
-        GcsPlacementGroupManager, gcs_placement_group_manager.h:228)."""
+        """Register a PG; reservation is atomic and retried until feasible
+        (reference: GcsPlacementGroupManager pending queue,
+        gcs_placement_group_manager.h:228). Clients poll get_placement_group
+        for CREATED."""
         with self._lock:
-            nodes = self.cluster.schedule_bundles(p["bundles"], p["strategy"])
-            if nodes is None:
-                return None
             self._pgs[p["pg_id"]] = {
-                "bundles": p["bundles"], "nodes": nodes,
+                "bundles": p["bundles"], "nodes": None, "state": "PENDING",
                 "strategy": p["strategy"], "name": p.get("name", "")}
-        return {"nodes": nodes}
+        self._try_schedule_pgs()
+        return True
+
+    def _try_schedule_pgs(self) -> None:
+        """Attempt atomic reservation of every pending PG (called on create
+        and periodically from the health loop so freed resources are
+        picked up)."""
+        with self._lock:
+            for pg in self._pgs.values():
+                if pg["state"] != "PENDING":
+                    continue
+                nodes = self.cluster.schedule_bundles(pg["bundles"],
+                                                      pg["strategy"])
+                if nodes is not None:
+                    pg["nodes"] = nodes
+                    pg["state"] = "CREATED"
 
     def _h_remove_pg(self, p, ctx):
         with self._lock:
             pg = self._pgs.pop(p["pg_id"], None)
             if pg is None:
                 return False
-            for node_id, bundle in zip(pg["nodes"], pg["bundles"]):
-                if node_id in self._nodes and self._nodes[node_id].alive:
-                    self.cluster.release(node_id, bundle)
+            if pg["state"] == "CREATED":
+                for node_id, bundle in zip(pg["nodes"], pg["bundles"]):
+                    if node_id in self._nodes and self._nodes[node_id].alive:
+                        self.cluster.release(node_id, bundle)
+        self._try_schedule_pgs()
         return True
 
     def _h_get_pg(self, p, ctx):

@@ -30,7 +30,8 @@ from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
 
 
 class _WorkerEntry:
-    __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id")
+    __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id",
+                 "chips")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -39,6 +40,7 @@ class _WorkerEntry:
         self.ready = threading.Event()
         self.state = "starting"  # starting | idle | leased | actor | dead
         self.actor_id: Optional[bytes] = None
+        self.chips: Optional[list] = None  # TPU chip ids owned (single-use)
 
 
 class NodeDaemon:
@@ -51,6 +53,17 @@ class NodeDaemon:
         self.session = session
         self.node_id = NodeID.from_random().hex()
         self.resources = dict(resources)
+        # TPU hosts advertise chip + gang resources (env-detected only —
+        # a jax probe here would claim the chips; see accelerators/tpu.py)
+        from ray_tpu.accelerators.tpu import (ChipAllocator,
+                                              TPUAcceleratorManager)
+        if "TPU" not in self.resources:
+            tpu_info = TPUAcceleratorManager.detect()
+            if tpu_info is not None:
+                self.resources.update(
+                    TPUAcceleratorManager.node_resources(tpu_info))
+        n_chips = int(self.resources.get("TPU", 0))
+        self.chips = ChipAllocator(n_chips) if n_chips > 0 else None
         self.shm_name = f"/rtpu_{session[:8]}_{self.node_id[:8]}"
         self.store = ShmStore.create(
             self.shm_name,
@@ -86,17 +99,24 @@ class NodeDaemon:
 
     # ------------------------------------------------------------ worker pool
 
-    def _spawn_worker(self) -> _WorkerEntry:
+    def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
+                      chips: Optional[list] = None) -> _WorkerEntry:
         worker_id = WorkerID.from_random().binary()
         from ray_tpu.runtime.spawn import child_env
-        env = child_env({"RTPU_SESSION": self.session})
+        extra = {"RTPU_SESSION": self.session}
+        if env_extra:
+            extra.update(env_extra)
+        env = child_env(extra)
         cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main",
                self.address, self.head_addr, self.shm_name,
                worker_id.hex(), config_mod.GlobalConfig.to_json()]
         proc = subprocess.Popen(cmd, env=env)
         entry = _WorkerEntry(worker_id, proc)
+        entry.chips = chips
         with self._lock:
             self._workers[worker_id] = entry
+            if chips is not None:
+                self.chips.assigned[worker_id] = chips
         threading.Thread(target=self._wait_worker, args=(entry,),
                          daemon=True, name="node-waitpid").start()
         return entry
@@ -110,6 +130,8 @@ class NodeDaemon:
             self._workers.pop(entry.worker_id, None)
             if entry.worker_id in self._idle:
                 self._idle.remove(entry.worker_id)
+            if self.chips is not None:
+                self.chips.release(entry.worker_id)
         entry.ready.set()
         if self._stopped.is_set() or prev_state == "stopping":
             return
@@ -129,15 +151,27 @@ class NodeDaemon:
             if entry is None:
                 return False
             entry.address = p["address"]
-            if entry.state == "starting":
+            # chip workers never join the generic idle pool — leasing one
+            # for a CPU task would strand its chips
+            if entry.state == "starting" and entry.chips is None:
                 entry.state = "idle"
                 self._idle.append(worker_id)
         entry.ready.set()
         return True
 
     def _h_lease_worker(self, p, ctx):
-        """Pop an idle worker (spawning if under the cap); None = busy."""
+        """Pop an idle worker (spawning if under the cap); None = busy.
+
+        TPU leases get a dedicated single-use worker spawned with
+        TPU_VISIBLE_CHIPS for its allocated chips (visibility must be set
+        before the process's TPU runtime initializes — reference:
+        accelerators/tpu.py:31); generic idle workers are never reused for
+        chips and chip workers never return to the generic pool.
+        """
         cfg = config_mod.GlobalConfig
+        n_tpu = int(p.get("resources", {}).get("TPU", 0) or 0)
+        if n_tpu > 0 and self.chips is not None:
+            return self._lease_tpu_worker(n_tpu, cfg)
         with self._lock:
             while self._idle:
                 wid = self._idle.pop(0)
@@ -166,14 +200,69 @@ class NodeDaemon:
                         "worker_addr": entry.address}
         return None
 
+    def _lease_tpu_worker(self, n_tpu: int, cfg):
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+        try:
+            TPUAcceleratorManager.validate_chip_request(n_tpu)
+        except ValueError as e:
+            # structured reply, not an exception: an invalid shape must not
+            # leak head-side acquisitions or crash client lease threads
+            return {"invalid": str(e)}
+        with self._lock:
+            if len(self._workers) + self._spawn_reserved >= cfg.worker_pool_max:
+                return None
+            chips = self.chips.allocate(b"__reserving__", n_tpu)
+            if chips is None:
+                return None
+            self.chips.assigned.pop(b"__reserving__", None)
+            self._spawn_reserved += 1
+        entry = None
+        try:
+            env = TPUAcceleratorManager.visibility_env(chips)
+            entry = self._spawn_worker(env_extra=env, chips=chips)
+        finally:
+            with self._lock:
+                self._spawn_reserved -= 1
+                if entry is None:
+                    # spawn raised after allocation — give the chips back
+                    self.chips.release_chips(chips)
+        if not entry.ready.wait(timeout=cfg.rpc_connect_timeout_s * 3):
+            # stuck spawn: kill it so its chips free via _wait_worker
+            # instead of the worker later joining the pool holding chips
+            try:
+                entry.proc.kill()
+            except OSError:
+                pass
+            return None
+        with self._lock:
+            if entry.state in ("starting", "idle"):
+                if entry.worker_id in self._idle:
+                    self._idle.remove(entry.worker_id)
+                entry.state = "leased"
+                return {"worker_id": entry.worker_id,
+                        "worker_addr": entry.address}
+        return None
+
     def _h_return_worker(self, p, ctx):
         with self._lock:
             entry = self._workers.get(p["worker_id"])
             if entry is None or entry.state == "dead":
                 return False
-            entry.state = "idle"
-            if entry.worker_id not in self._idle:
-                self._idle.append(entry.worker_id)
+            if entry.chips is not None:
+                # chip workers are single-use: their TPU runtime already
+                # initialized against specific chips — kill to free them
+                entry.state = "stopping"
+                proc = entry.proc
+            else:
+                entry.state = "idle"
+                if entry.worker_id not in self._idle:
+                    self._idle.append(entry.worker_id)
+                proc = None
+        if proc is not None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
         return True
 
     def _h_start_actor(self, p, ctx):

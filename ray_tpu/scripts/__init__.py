@@ -1,0 +1,1 @@
+"""CLI entrypoints (reference: python/ray/scripts/scripts.py)."""

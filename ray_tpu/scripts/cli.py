@@ -1,0 +1,196 @@
+"""`python -m ray_tpu` — cluster CLI.
+
+Role-equivalent to the reference's `ray` CLI (reference:
+python/ray/scripts/scripts.py:89 — start/stop/status and the state-API
+`ray list ...` family, python/ray/util/state/api.py:110). argparse instead
+of click; the head's state_dump RPC is the single aggregation point
+(reference: dashboard/state_aggregator.py collapses GCS+raylet sources the
+same way).
+
+Commands:
+  start --head [--num-cpus N] [--port P]     boot a head (+ 1 node daemon)
+  start --address H:P [--num-cpus N]         add a node daemon to a cluster
+  status [--address H:P]                     cluster resources + nodes
+  list {nodes,actors,workers,placement-groups,objects} [--address H:P]
+  stop [--address H:P]                       stop node daemons + head
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+ADDRESS_FILE = "head_address"
+
+
+def _session_dir() -> str:
+    from ray_tpu.core.config import GlobalConfig
+    return GlobalConfig.session_dir
+
+
+def save_address(address: str) -> None:
+    os.makedirs(_session_dir(), exist_ok=True)
+    with open(os.path.join(_session_dir(), ADDRESS_FILE), "w") as f:
+        f.write(address)
+
+
+def load_address(explicit: Optional[str]) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("RTPU_ADDRESS")
+    if env:
+        return env
+    path = os.path.join(_session_dir(), ADDRESS_FILE)
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        raise SystemExit(
+            "no cluster address: pass --address, set RTPU_ADDRESS, or "
+            "run `python -m ray_tpu start --head` first") from None
+
+
+def _client(address: str):
+    from ray_tpu.runtime.protocol import RpcClient
+    return RpcClient(address, name="cli")
+
+
+def cmd_start(args) -> int:
+    from ray_tpu.runtime.cluster_backend import start_head, start_node
+    resources = {"CPU": float(args.num_cpus if args.num_cpus is not None
+                              else (os.cpu_count() or 1))}
+    if args.head:
+        session = os.urandom(4).hex()
+        head_proc, address = start_head(session, port=args.port or None)
+        node_proc = start_node(address, session, resources=resources)
+        save_address(address)
+        print(f"head started at {address} "
+              f"(head pid {head_proc.pid}, node pid {node_proc.pid})")
+        print(f"connect with: ray_tpu.init(address={address!r})")
+        return 0
+    address = load_address(args.address)
+    client = _client(address)
+    session = client.call("connect_driver", {}).get("session", "")
+    from ray_tpu.runtime.cluster_backend import start_node as _sn
+    proc = _sn(address, session, resources=resources)
+    deadline = time.monotonic() + 30
+    known = time.monotonic()
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            print(f"node daemon exited rc={proc.returncode}",
+                  file=sys.stderr)
+            return 1
+        time.sleep(0.2)
+        nodes = client.call("list_nodes")
+        if any(n["alive"] for n in nodes):
+            break
+    print(f"node daemon pid {proc.pid} joined {address}")
+    return 0
+
+
+def cmd_status(args) -> int:
+    address = load_address(args.address)
+    client = _client(address)
+    total = client.call("cluster_resources")
+    avail = client.call("available_resources")
+    nodes = client.call("list_nodes")
+    alive = [n for n in nodes if n["alive"]]
+    print(f"cluster at {address}: {len(alive)}/{len(nodes)} nodes alive")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} available")
+    return 0
+
+
+def cmd_list(args) -> int:
+    address = load_address(args.address)
+    client = _client(address)
+    dump = client.call("state_dump")
+    if args.what == "nodes":
+        rows = dump["nodes"]
+    elif args.what == "actors":
+        rows = dump["actors"]
+    elif args.what == "placement-groups":
+        rows = dump["placement_groups"]
+    elif args.what == "workers":
+        rows = []
+        for n in dump["nodes"]:
+            if not n["alive"]:
+                continue
+            try:
+                for w in _client(n["address"]).call("list_workers"):
+                    rows.append({"node_id": n["node_id"], **w})
+            except Exception:
+                pass
+    elif args.what == "objects":
+        rows = []
+        for n in dump["nodes"]:
+            if not n["alive"]:
+                continue
+            try:
+                st = _client(n["address"]).call("store_stats")
+                rows.append({"node_id": n["node_id"], **st})
+            except Exception:
+                pass
+    else:
+        raise SystemExit(f"unknown list target {args.what}")
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=str))
+    else:
+        for r in rows:
+            print("  ".join(f"{k}={v}" for k, v in r.items()))
+    print(f"({len(rows)} {args.what})", file=sys.stderr)
+    return 0
+
+
+def cmd_stop(args) -> int:
+    address = load_address(args.address)
+    client = _client(address)
+    nodes = client.call("list_nodes")
+    for n in nodes:
+        if not n["alive"]:
+            continue
+        try:
+            _client(n["address"]).call("shutdown", timeout=5.0)
+        except Exception:
+            pass
+    print(f"stopped {sum(1 for n in nodes if n['alive'])} node daemon(s); "
+          "head left running (kill its pid to stop fully)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="boot a head or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--port", type=int, default=None)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("status", help="cluster resources and nodes")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("what", choices=["nodes", "actors", "workers",
+                                     "placement-groups", "objects"])
+    sp.add_argument("--address")
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("stop", help="stop node daemons")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_stop)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

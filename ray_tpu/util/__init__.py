@@ -1,0 +1,6 @@
+"""User-facing utilities (reference: python/ray/util/)."""
+
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group)
+
+__all__ = ["placement_group", "remove_placement_group", "PlacementGroup"]

@@ -1,0 +1,56 @@
+"""Programmatic state API (reference: python/ray/util/state/api.py:110 —
+list_actors at :784, list_nodes, etc.). All queries aggregate through the
+head's state_dump, the single source the CLI also uses."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ray_tpu.core.worker import require_connected
+
+
+def _dump() -> dict:
+    worker = require_connected()
+    backend = worker.backend
+    if hasattr(backend, "state_dump"):
+        return backend.state_dump()
+    # local mode: synthesize from the in-process backend
+    return {
+        "nodes": [{"node_id": "local", "alive": True,
+                   "resources": backend.cluster_resources(),
+                   "address": "local"}],
+        "actors": [{"actor_id": aid.hex(), "class": a.spec.name,
+                    "state": "DEAD" if a.dead else "ALIVE",
+                    "node_id": "local", "name": a.spec.registered_name,
+                    "restarts": 0, "reason": a.death_reason}
+                   for aid, a in backend.actors.items()],
+        "leases": 0,
+        "placement_groups": [],
+    }
+
+
+def list_nodes() -> List[Dict]:
+    return _dump()["nodes"]
+
+
+def list_actors(state: str = "") -> List[Dict]:
+    actors = _dump()["actors"]
+    if state:
+        actors = [a for a in actors if a["state"] == state]
+    return actors
+
+
+def list_placement_groups() -> List[Dict]:
+    return _dump()["placement_groups"]
+
+
+def summarize() -> Dict:
+    d = _dump()
+    return {
+        "nodes_alive": sum(1 for n in d["nodes"] if n["alive"]),
+        "nodes_total": len(d["nodes"]),
+        "actors": len(d["actors"]),
+        "actors_alive": sum(1 for a in d["actors"] if a["state"] == "ALIVE"),
+        "placement_groups": len(d["placement_groups"]),
+        "active_leases": d["leases"],
+    }

@@ -1,0 +1,17 @@
+"""Placement-group API in local mode (separate module: needs a fresh,
+non-cluster ray_tpu.init)."""
+
+import ray_tpu as rt
+from ray_tpu.util import placement_group
+
+
+def test_local_mode_pg():
+    rt.init(local_mode=True, num_cpus=4)
+    try:
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.wait(5)
+        bad = placement_group([{"CPU": 64}], strategy="PACK")
+        assert not bad.wait(0.5)
+        assert bad.state()["state"] == "INFEASIBLE"
+    finally:
+        rt.shutdown()

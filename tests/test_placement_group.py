@@ -1,0 +1,171 @@
+"""Placement groups, TPU gang resources, chip allocation.
+
+Reference coverage model: python/ray/tests/test_placement_group*.py plus
+the TPU accelerator-manager unit tests
+(python/ray/tests/accelerators/test_tpu.py).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.accelerators.tpu import ChipAllocator, TPUAcceleratorManager
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import placement_group, remove_placement_group
+
+
+# ------------------------------------------------------- unit: TPU manager
+
+
+def test_tpu_resources_from_env(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = TPUAcceleratorManager.node_resources()
+    assert res["TPU"] == 4.0           # v5p hosts carry 4 chips
+    assert res["TPU-v5p"] == 4.0
+    assert res["TPU-v5p-16-head"] == 1.0  # gang resource on worker 0
+
+
+def test_tpu_resources_non_head_worker(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = TPUAcceleratorManager.node_resources()
+    assert "TPU-v5p-16-head" not in res
+    assert res["TPU"] == 4.0
+
+
+def test_tpu_v5e_chips(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = TPUAcceleratorManager.node_resources()
+    assert res["TPU"] == 8.0
+
+
+def test_chip_request_validation():
+    TPUAcceleratorManager.validate_chip_request(4)
+    with pytest.raises(ValueError):
+        TPUAcceleratorManager.validate_chip_request(3)
+
+
+def test_visibility_env():
+    env = TPUAcceleratorManager.visibility_env([0, 1, 2, 3])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+
+
+def test_chip_allocator():
+    alloc = ChipAllocator(4)
+    a = alloc.allocate(b"w1", 2)
+    b = alloc.allocate(b"w2", 2)
+    assert sorted(a + b) == [0, 1, 2, 3]
+    assert alloc.allocate(b"w3", 1) is None
+    alloc.release(b"w1")
+    assert alloc.allocate(b"w3", 2) == a
+
+
+# ------------------------------------------------- cluster: PG semantics
+
+
+@pytest.fixture(scope="module")
+def pg_cluster():
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={
+        "nodeA": 1, "TPU": 4, "TPU-v5p": 4, "TPU-v5p-8-head": 1})
+    cluster.add_node(num_cpus=2, resources={
+        "nodeB": 1, "TPU": 4, "TPU-v5p": 4})
+    rt.init(address=cluster.address)
+    yield cluster
+    rt.shutdown()
+    cluster.shutdown()
+
+
+def test_pg_pack_ready(pg_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    st = pg.state()
+    assert st["state"] == "CREATED"
+    # PACK prefers one node for both bundles
+    assert len(set(st["nodes"])) == 1
+    remove_placement_group(pg)
+
+
+def test_pg_strict_spread_lands_on_distinct_nodes(pg_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+    nodes = pg.state()["nodes"]
+    assert len(set(nodes)) == 2
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_stays_pending(pg_cluster):
+    """PG-or-nothing: 3 STRICT_SPREAD bundles on 2 nodes can never all
+    reserve — the PG must stay PENDING, not partially place."""
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg.wait(2)
+    assert pg.state()["state"] == "PENDING"
+    remove_placement_group(pg)
+
+
+def test_pg_queues_until_resources_free(pg_cluster):
+    """A pending PG is created once a blocking one is removed."""
+    first = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+    assert first.wait(30)
+    second = placement_group([{"CPU": 2}], strategy="PACK")
+    assert not second.wait(1.5)
+    remove_placement_group(first)
+    assert second.wait(30), "queued PG never created after resources freed"
+    remove_placement_group(second)
+
+
+def test_task_runs_in_bundle(pg_cluster):
+    """A task submitted into bundle 1 of a STRICT_SPREAD PG runs on the
+    bundle's node."""
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+    target = pg.bundle_node(1)
+
+    @rt.remote(placement_group=pg, placement_group_bundle_index=1, num_cpus=0)
+    def where():
+        from ray_tpu.core.worker import global_worker
+        return global_worker.node_id
+
+    assert rt.get(where.remote(), timeout=60) == target
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg_bundle(pg_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @rt.remote
+    class Where:
+        def node(self):
+            from ray_tpu.core.worker import global_worker
+            return global_worker.node_id
+
+    a = Where.options(placement_group=pg,
+                      placement_group_bundle_index=0).remote()
+    assert rt.get(a.node.remote(), timeout=60) == pg.bundle_node(0)
+    rt.kill(a)
+    remove_placement_group(pg)
+
+
+def test_tpu_gang_reservation(pg_cluster):
+    """A single-bundle PG on the slice-head gang resource claims the slice
+    atomically: only node A advertises TPU-v5p-8-head (SURVEY.md §2.6 gang
+    scheduling row; reference accelerators/tpu.py:330,377)."""
+    pg = placement_group([{"TPU-v5p-8-head": 1}], strategy="STRICT_PACK")
+    assert pg.wait(30)
+    nodes = rt.nodes()
+    head_node = next(n["NodeID"] for n in nodes
+                     if "TPU-v5p-8-head" in n["Resources"])
+    assert pg.state()["nodes"][0] == head_node
+    # a second gang reservation must queue (the slice is taken)
+    pg2 = placement_group([{"TPU-v5p-8-head": 1}], strategy="STRICT_PACK")
+    assert not pg2.wait(1.5)
+    remove_placement_group(pg)
+    assert pg2.wait(30)
+    remove_placement_group(pg2)
+
+

@@ -1,0 +1,69 @@
+"""State API + CLI tests (reference coverage model:
+python/ray/tests/test_state_api.py + CLI smoke in test_cli.py)."""
+
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def state_rt():
+    rt.init(num_cpus=2, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024})
+    yield rt
+    rt.shutdown()
+
+
+def _cli(*args, address):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args, "--address", address],
+        capture_output=True, text=True, timeout=60,
+        env={**__import__("os").environ,
+             "PYTHONPATH": __import__("os").path.dirname(
+                 __import__("os").path.dirname(rt.__file__))})
+
+
+def test_state_api_lists(state_rt):
+    @rt.remote
+    class Marker:
+        def ping(self):
+            return "pong"
+
+    name = f"m-{uuid.uuid4().hex[:6]}"
+    a = Marker.options(name=name).remote()
+    rt.get(a.ping.remote(), timeout=60)
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    actors = state.list_actors(state="ALIVE")
+    assert any(x["name"].endswith(name) for x in actors)
+    s = state.summarize()
+    assert s["nodes_alive"] == 1 and s["actors_alive"] >= 1
+
+
+def test_cli_status_and_list(state_rt):
+    from ray_tpu.core.worker import global_worker
+    address = global_worker.backend.head_addr
+
+    out = _cli("status", address=address)
+    assert out.returncode == 0, out.stderr
+    assert "nodes alive" in out.stdout and "CPU" in out.stdout
+
+    out = _cli("list", "nodes", address=address)
+    assert out.returncode == 0, out.stderr
+    assert "node_id=" in out.stdout
+
+    out = _cli("list", "actors", "--format", "json", address=address)
+    assert out.returncode == 0, out.stderr
+    import json
+    rows = json.loads(out.stdout)
+    assert isinstance(rows, list)
+
+    out = _cli("list", "objects", address=address)
+    assert out.returncode == 0, out.stderr
+    assert "capacity=" in out.stdout
