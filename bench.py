@@ -5,9 +5,14 @@ vs_baseline is measured MFU / the 45% north-star target (BASELINE.md §ML —
 the reference publishes no in-tree ML numbers; 45% MFU is the driver-set
 target).
 
-Methodology: real training steps (bf16 compute, fp32 adamw, remat,
-donation) on a ~430M-param Llama; loss fetched to host every step so the
-timing is honestly synchronous through the device tunnel.
+Methodology: real training steps (bf16 compute, adafactor, remat,
+donation) on a ~1.2B-param Llama. Steps dispatch pipelined through donated
+buffers; only the FINAL loss is fetched, which bounds the whole timed
+sequence (the device can't run ahead of its own data dependencies).
+MFU convention: FLOPs/token = 6·N + 12·L·d·s, i.e. full (non-causal)
+attention-score FLOPs — the PaLM-appendix convention — while the flash
+kernels skip above-diagonal blocks, so the attention term credits ~2x the
+score work actually done (<2% of total FLOPs at this size).
 """
 
 from __future__ import annotations

@@ -325,6 +325,8 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Approx training FLOPs/token: 6·N_params + attention score term.
 
     The embed matrix counts: it is tied as the LM head, so its matmul runs.
+    The attention term uses the full (non-causal) 12·L·d·s convention
+    (PaLM appendix B); causal kernels do ~half that score work.
     """
     attn = 12 * cfg.n_layers * cfg.dim * seq_len  # fwd+bwd qk+pv scores
     return 6.0 * num_params(cfg) + attn

@@ -34,11 +34,19 @@ def _unesc(key: str) -> str:
 
 
 def _flatten(tree, prefix=""):
-    """Flatten nested dict/list/tuple pytrees into {path: leaf}."""
+    """Flatten nested dict/list/tuple pytrees into {path: leaf}.
+
+    Dict keys keep their type: int keys get a 'di:' token (str keys 'd:')
+    so restore rebuilds real int keys — otherwise a dict with keys >= 10
+    would restore in lexicographic order ('10' < '2') and load(target=...)
+    would zip leaves against the target's numeric order, silently assigning
+    arrays to the wrong leaves.
+    """
     out = {}
     if isinstance(tree, dict) and tree:
-        for k in sorted(tree):
-            out.update(_flatten(tree[k], f"{prefix}d:{_esc(str(k))}/"))
+        for k in sorted(tree, key=lambda k: (isinstance(k, str), k)):
+            tag = "di" if type(k) is int else "d"
+            out.update(_flatten(tree[k], f"{prefix}{tag}:{_esc(str(k))}/"))
     elif isinstance(tree, (list, tuple)) and tree:
         tag = "l" if isinstance(tree, list) else "t"
         for i, v in enumerate(tree):
@@ -72,14 +80,16 @@ def _unflatten(flat: Dict[str, Any]):
         if not isinstance(node, _Node):
             return node
         kinds = {tok.split(":", 1)[0] for tok in node}
-        if len(kinds) != 1:
+        if len(kinds) != 1 and kinds != {"d", "di"}:  # str+int keys may mix
             raise ValueError(f"mixed container kinds at one node: {kinds}")
-        kind = kinds.pop()
+        kind = kinds.pop() if len(kinds) == 1 else "d"
         if set(node) == {f"{kind}:<empty>"}:
             return {"d": {}, "l": [], "t": ()}[kind]
-        items = {_unesc(tok.split(":", 1)[1]): convert(v)
-                 for tok, v in node.items()}
-        if kind == "d":
+        items = {}
+        for tok, v in node.items():
+            tag, key = tok.split(":", 1)
+            items[int(key) if tag == "di" else _unesc(key)] = convert(v)
+        if kind in ("d", "di"):
             return items
         seq = [items[str(i)] for i in range(len(items))]
         return seq if kind == "l" else tuple(seq)

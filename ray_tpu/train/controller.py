@@ -71,7 +71,8 @@ class TrainController:
                 per_worker = group.run(
                     self.train_fn, self.storage_path,
                     self.train_loop_config, restore,
-                    self.run_config.checkpoint_config.num_to_keep)
+                    self.run_config.checkpoint_config.num_to_keep,
+                    self.run_config.checkpoint_config.checkpoint_frequency)
                 history.extend(per_worker[0])
                 self.state = ControllerState.FINISHED
                 return Result(

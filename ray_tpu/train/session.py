@@ -19,13 +19,15 @@ class TrainContext:
     def __init__(self, rank: int, world_size: int, storage_path: str,
                  ckpt_manager: Optional[CheckpointManager] = None,
                  restore_from: Optional[Checkpoint] = None,
-                 train_loop_config: Optional[dict] = None):
+                 train_loop_config: Optional[dict] = None,
+                 checkpoint_frequency: int = 0):
         self.rank = rank
         self.world_size = world_size
         self.storage_path = storage_path
         self.ckpt_manager = ckpt_manager
         self.restore_from = restore_from
         self.train_loop_config = train_loop_config or {}
+        self.checkpoint_frequency = checkpoint_frequency
         self.reported: List[Dict[str, Any]] = []
         self.step = 0
 
@@ -38,10 +40,18 @@ class TrainContext:
 
     def report(self, metrics: Dict[str, Any],
                checkpoint_tree: Any = None) -> None:
-        """Record metrics; optionally snapshot a pytree checkpoint (rank 0)."""
+        """Record metrics; optionally snapshot a pytree checkpoint (rank 0).
+
+        With CheckpointConfig.checkpoint_frequency=N, only every Nth report
+        persists the offered tree (reference: air CheckpointConfig — the
+        trainer thins framework-offered checkpoints, not user metrics).
+        """
         self.step += 1
         entry = dict(metrics)
         entry["_step"] = self.step
+        if self.checkpoint_frequency > 0 \
+                and self.step % self.checkpoint_frequency != 0:
+            checkpoint_tree = None
         if checkpoint_tree is not None and self.rank == 0 \
                 and self.ckpt_manager is not None:
             ckpt = self.ckpt_manager.save(checkpoint_tree, self.step, metrics)

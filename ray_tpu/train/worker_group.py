@@ -32,14 +32,16 @@ class _TrainWorker:
     def run(self, fn: Callable, storage_path: str,
             train_loop_config: Optional[dict],
             restore_path: Optional[str],
-            num_to_keep: Optional[int]) -> List[dict]:
+            num_to_keep: Optional[int],
+            checkpoint_frequency: int = 0) -> List[dict]:
         ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
             storage_path=storage_path,
             ckpt_manager=CheckpointManager(
                 storage_path, num_to_keep=num_to_keep),
             restore_from=(Checkpoint(restore_path) if restore_path else None),
-            train_loop_config=train_loop_config)
+            train_loop_config=train_loop_config,
+            checkpoint_frequency=checkpoint_frequency)
         if restore_path:
             # Continue the step numbering of the restored run so restart
             # checkpoints never collide with (or sort below) earlier ones.
@@ -81,11 +83,13 @@ class WorkerGroup:
     def run(self, fn: Callable, storage_path: str,
             train_loop_config: Optional[dict],
             restore: Optional[Checkpoint],
-            num_to_keep: Optional[int]) -> List[List[dict]]:
+            num_to_keep: Optional[int],
+            checkpoint_frequency: int = 0) -> List[List[dict]]:
         """Execute the loop on every worker; raise WorkerGroupError on the
         first failure (reference: backend_executor re-raises worker errors)."""
         refs = [w.run.remote(fn, storage_path, train_loop_config,
-                             restore.path if restore else None, num_to_keep)
+                             restore.path if restore else None, num_to_keep,
+                             checkpoint_frequency)
                 for w in self.workers]
         # Await completions in ARRIVAL order, not rank order: a crash on
         # rank>0 must surface even while rank 0 blocks in a collective

@@ -45,6 +45,21 @@ class TestCheckpoint:
         bare = Checkpoint.save(np.arange(3), str(tmp_path / "c2")).load()
         assert np.array_equal(bare, np.arange(3))
 
+    def test_int_keys_roundtrip_in_numeric_order(self, tmp_path):
+        # int keys >= 10 must restore as ints (numeric order), not strings
+        # ('10' < '2' lexicographically would misassign leaves under
+        # load(target=...)). Mixed int+str keys in one dict must survive too.
+        tree = {"layers": {i: np.full(2, i, np.float32) for i in range(12)}}
+        back = Checkpoint.save(tree, str(tmp_path / "ck")).load()
+        assert set(back["layers"]) == set(tree["layers"])
+        for k, v in tree["layers"].items():
+            assert np.array_equal(back["layers"][k], v), k
+        # target= zips leaves in jax.tree order; int keys sort numerically
+        target = {"layers": {i: np.zeros(2, np.float32) for i in range(12)}}
+        restored = Checkpoint(str(tmp_path / "ck")).load(target=target)
+        for k, v in tree["layers"].items():
+            assert np.array_equal(restored["layers"][k], v), k
+
     def test_load_into_target_structure(self, tmp_path):
         # namedtuple pytrees (optax states) normalize to tuples on save;
         # target= restores leaves into the live structure (orbax pattern).
@@ -143,6 +158,22 @@ class TestJaxTrainer:
         # newest state, not a stale pre-failure dir
         from ray_tpu.train.checkpoint import CheckpointManager as CM
         assert CM.step_of(result.checkpoint.path) >= 6
+
+    def test_checkpoint_frequency_thins_saves(self, rtpu_local, tmp_path):
+        trainer = JaxTrainer(
+            _mlp_loop,
+            train_loop_config={"steps": 6, "fail_at": None},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="freq", storage_path=str(tmp_path),
+                checkpoint_config=CheckpointConfig(checkpoint_frequency=3)))
+        result = trainer.fit()
+        run_dir = result.path
+        dirs = sorted(d for d in os.listdir(run_dir)
+                      if d.startswith("checkpoint_"))
+        # _mlp_loop offers a checkpoint every report; frequency=3 keeps
+        # only steps 3 and 6
+        assert dirs == ["checkpoint_00000003", "checkpoint_00000006"]
 
     def test_failure_budget_exhausted_raises(self, rtpu_local, tmp_path):
         def always_fail(config):
