@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import threading
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu._native.build import build as _build_native
@@ -92,6 +94,25 @@ def _load():
     return lib
 
 
+def _release_pin(store: "ShmStore", key: bytes) -> None:
+    """weakref.finalize target for guarded get() views. After close() has
+    drained the guard table (releasing the pins itself), or at interpreter
+    shutdown, this is a no-op."""
+    try:
+        with store._guard_lock:
+            n = store._guarded.get(key, 0)
+            if n <= 0:
+                return  # already drained by close()
+            if n == 1:
+                store._guarded.pop(key)
+            else:
+                store._guarded[key] = n - 1
+        if store._h:
+            store._lib.rtpu_store_release(store._h, key)
+    except Exception:  # noqa: BLE001 — finalizers must never raise
+        pass
+
+
 class ObjectStoreFull(Exception):
     pass
 
@@ -108,6 +129,12 @@ class ShmStore:
         self.name = name
         self._owner = owner
         self._lib = _load()
+        # outstanding guarded-get pins (key -> count): drained by close()
+        # so a process exiting with live views doesn't leak shared
+        # pin_counts in the arena (which would make delete_pending objects
+        # unreclaimable for the node's lifetime)
+        self._guard_lock = threading.Lock()
+        self._guarded: Dict[bytes, int] = {}
 
     @classmethod
     def create(cls, name: str, capacity: int, slots: int = 1 << 16) -> "ShmStore":
@@ -148,10 +175,21 @@ class ShmStore:
         memoryview(buf).cast("B")[:] = data
         self.seal(object_id)
 
-    def get(self, object_id: bytes) -> Optional[memoryview]:
+    def get(self, object_id: bytes, guard: bool = False) -> Optional[memoryview]:
         """Return a pinned zero-copy view, or None if absent/unsealed.
 
-        Caller must release() when the view is no longer referenced.
+        ``guard=False``: caller must release() when done (byte-copy paths
+        that read and immediately drop the view).
+
+        ``guard=True``: the pin is released automatically when the LAST
+        derived view dies. Every memoryview/numpy array sliced out of the
+        returned view keeps the underlying ctypes exporter alive through
+        the buffer protocol, so a weakref finalizer on the exporter fires
+        exactly when no live Python object can still alias the arena
+        memory. Without this, freeing the ObjectRef while zero-copy reads
+        were still referenced let the arena reuse the region under them
+        (reference equivalent: plasma buffers keep a client pin until the
+        PlasmaBuffer is destructed).
         """
         ptr = ctypes.c_void_p()
         size = ctypes.c_uint64()
@@ -161,8 +199,13 @@ class ShmStore:
             return None
         if rc != OK:
             raise OSError(f"get failed rc={rc}")
-        return memoryview(
-            (ctypes.c_char * size.value).from_address(ptr.value)).cast("B")
+        arr = (ctypes.c_char * size.value).from_address(ptr.value)
+        if guard:
+            key = bytes(object_id)
+            with self._guard_lock:
+                self._guarded[key] = self._guarded.get(key, 0) + 1
+            weakref.finalize(arr, _release_pin, self, key)
+        return memoryview(arr).cast("B")
 
     def release(self, object_id: bytes) -> None:
         self._lib.rtpu_store_release(self._h, object_id)
@@ -180,6 +223,18 @@ class ShmStore:
 
     def close(self) -> None:
         if self._h:
+            # Drain outstanding guarded pins first: live views become
+            # dangling (the caller is shutting down), but the shared arena
+            # must see the pin_counts drop or delete_pending objects leak
+            # until the node restarts.
+            with self._guard_lock:
+                drained, self._guarded = dict(self._guarded), {}
+            for key, n in drained.items():
+                for _ in range(n):
+                    try:
+                        self._lib.rtpu_store_release(self._h, key)
+                    except Exception:  # noqa: BLE001
+                        break
             self._lib.rtpu_store_close(self._h)
             self._h = None
 

@@ -82,7 +82,12 @@ def serialize(value: Any) -> SerializedObject:
         def reducer_override(self, obj):
             if isinstance(obj, ObjectRef):
                 contained_refs.append(obj)
-            return NotImplemented
+                return NotImplemented
+            # Delegate to CloudPickler: its reducer_override is where
+            # by-value pickling of local functions/lambdas/classes lives —
+            # returning NotImplemented here would silently downgrade to
+            # by-reference pickling, which breaks closures in task args.
+            return super().reducer_override(obj)
 
     import io
     out = io.BytesIO()

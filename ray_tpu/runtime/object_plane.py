@@ -42,7 +42,6 @@ class ObjectPlane:
         self.node_shm = dict(node_shm)         # node_id -> shm name
         self.locations: Dict[ObjectID, str] = {}   # owned large obj -> node
         self.owner_addrs: Dict[bytes, str] = {}    # worker_id -> rpc address
-        self.pinned: Set[bytes] = set()
         self._peers = ClientPool(name="objplane")
         self._fetching: Set[ObjectID] = set()
         self._lock = threading.Lock()
@@ -262,17 +261,15 @@ class ObjectPlane:
                     raise ObjectLostError(oid.hex(), "no longer in shm")
                 node_id = reply["shm"]
             self._pull_to_local(oid, node_id)
-        view = self.store.get(oid.binary())
+        # guard=True: each read holds its own pin, released when the last
+        # zero-copy view derived from this get dies — NOT when the
+        # ObjectRef dies. Freeing the ref must never let the arena reuse
+        # memory still aliased by live numpy views (the corruption class
+        # this replaced: free → LRU reuse → a later block's bytes showing
+        # through an earlier block's array).
+        view = self.store.get(oid.binary(), guard=True)
         if view is None:
             raise ObjectLostError(oid.hex(), "evicted from shm")
-        # store.get pins on every call; this process holds at most one
-        # logical read pin per object (released on free/unborrow), so drop
-        # duplicate pins from repeated gets of the same ref.
-        with self._lock:
-            if oid.binary() in self.pinned:
-                self.store.release(oid.binary())
-            else:
-                self.pinned.add(oid.binary())
         value = serialization.deserialize(view)
         return value, False
 
@@ -314,14 +311,13 @@ class ObjectPlane:
     # ------------------------------------------------------------------ free
 
     def free_object(self, object_id: ObjectID) -> None:
-        """Owner decided the object is garbage (refcount hit zero)."""
+        """Owner decided the object is garbage (refcount hit zero).
+
+        Read pins are guard-managed (see get_from_store) so there is
+        nothing to release here; the holder node drops the primary copy
+        (the store defers actual reclamation until reader pins drain —
+        delete_pending in shm_store.cc)."""
         key = object_id.binary()
-        if key in self.pinned:
-            self.pinned.discard(key)
-            try:
-                self.store.release(key)
-            except OSError:
-                pass
         node_id = self.locations.pop(object_id, None)
         if node_id is not None:
             try:
@@ -344,21 +340,11 @@ class ObjectPlane:
                 pass
 
     def release_local_pin(self, object_id: ObjectID) -> None:
-        """A borrowed shm object is no longer referenced in this process."""
-        key = object_id.binary()
-        if key in self.pinned:
-            self.pinned.discard(key)
-            try:
-                self.store.release(key)
-            except OSError:
-                pass
+        """Borrow-release hook. Read pins are tied to view lifetime by the
+        guard in get_from_store, so the unborrow path has nothing to
+        release locally; kept as the seam where explicit local pinning
+        would go (reference: plasma client Release)."""
 
     def shutdown(self) -> None:
-        for key in list(self.pinned):
-            try:
-                self.store.release(key)
-            except OSError:
-                pass
-        self.pinned.clear()
         self._peers.close_all()
         self.store.close()

@@ -98,9 +98,22 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+#: Frames above this are treated as stream corruption. The cap must sit
+#: far above any legitimate frame (cross-node object pulls ship a whole
+#: object's bytes in one read_object reply, bounded by arena capacity) —
+#: its job is catching desynced headers, whose lengths are effectively
+#: random u64s: P(random < 1 TiB) = 2^40/2^64 ≈ 6e-8, so 1 TiB keeps
+#: nearly all the protection without ever rejecting real traffic.
+_MAX_FRAME_BYTES = 1 << 40
+
+
 def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
     header = _recv_exact(sock, _FRAME.size)
     req_id, length = _FRAME.unpack(header)
+    if length > _MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"frame length {length} exceeds protocol maximum "
+            f"({_MAX_FRAME_BYTES}); treating as stream corruption")
     return req_id, _recv_exact(sock, length)
 
 
