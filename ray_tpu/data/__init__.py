@@ -1,0 +1,21 @@
+"""ray_tpu.data — streaming, block-partitioned datasets for TPU ingest.
+
+Capability target: the reference's Ray Data core loop (reference:
+python/ray/data — Dataset at dataset.py:153, StreamingExecutor at
+_internal/execution/streaming_executor.py:48), rebuilt as a linear fused
+block pipeline with numpy-columnar blocks and static-shape batch iteration
+(see dataset.py / block.py docstrings for the design rationale).
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_items, from_numpy, range, read_csv, read_json, read_npy,
+    read_parquet, read_text)
+
+__all__ = [
+    "Block", "BlockAccessor", "Dataset", "MaterializedDataset",
+    "DataIterator", "from_items", "from_numpy", "range", "read_csv",
+    "read_json", "read_npy", "read_parquet", "read_text",
+]

@@ -1,0 +1,348 @@
+"""Dataset — lazy, streaming, block-partitioned datasets.
+
+Role-equivalent to the reference's Dataset (reference:
+python/ray/data/dataset.py:153 with the logical-plan machinery under
+data/_internal/logical/). Redesigned TPU-first:
+
+  - a Dataset is a list of picklable read thunks plus a linear chain of
+    per-block transforms — no operator DAG, because the TPU ingest path is
+    a straight line ending in a host→device feed;
+  - execution is the streaming executor (one fused task per block, bounded
+    in-flight window — see _internal/streaming_executor.py);
+  - ``iter_batches`` re-chunks rows to EXACT batch_size across block
+    boundaries so downstream jitted programs see one static shape
+    (XLA recompiles per shape; the reference has no such constraint).
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data._internal.streaming_executor import (
+    ExecStats, execute_streaming)
+
+
+#: internal transform signature: fn(block, block_index) -> block; the index
+#: lets stateless per-block transforms derive distinct randomness per block
+_Transform = Callable[[Block, int], Block]
+
+
+@dataclass
+class _Plan:
+    """read thunks + fused transform chain (+ executor knobs)."""
+    read_fns: List[Callable[[], Block]]
+    transforms: List[_Transform] = field(default_factory=list)
+    limit_rows: Optional[int] = None
+    max_in_flight: int = 8
+    ray_remote_args: Dict[str, Any] = field(default_factory=dict)
+
+    def fused(self) -> Optional[_Transform]:
+        if not self.transforms:
+            return None
+        chain = list(self.transforms)
+
+        def _fused(block: Block, idx: int) -> Block:
+            for t in chain:
+                block = t(block, idx)
+            return block
+        return _fused
+
+
+def _map_rows_transform(fn: Callable[[Any], Any]) -> _Transform:
+    def _t(block: Block, idx: int) -> Block:
+        rows = BlockAccessor.for_block(block).to_rows()
+        return BlockAccessor.from_rows([fn(r) for r in rows])
+    return _t
+
+
+def _flat_map_transform(fn: Callable[[Any], Sequence[Any]]) -> _Transform:
+    def _t(block: Block, idx: int) -> Block:
+        out: List[Any] = []
+        for r in BlockAccessor.for_block(block).to_rows():
+            out.extend(fn(r))
+        return BlockAccessor.from_rows(out)
+    return _t
+
+
+def _filter_transform(fn: Callable[[Any], bool]) -> _Transform:
+    def _t(block: Block, idx: int) -> Block:
+        rows = BlockAccessor.for_block(block).to_rows()
+        return BlockAccessor.from_rows([r for r in rows if fn(r)])
+    return _t
+
+
+def _map_batches_transform(fn, batch_format: str,
+                           batch_size: Optional[int]) -> _Transform:
+    def _t(block: Block, idx: int) -> Block:
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        if batch_size is None or n <= batch_size:
+            return _normalize_batch(fn(acc.to_batch(batch_format)))
+        outs = []
+        for s in range(0, n, batch_size):
+            sub = BlockAccessor.for_block(acc.slice(s, min(s + batch_size, n)))
+            outs.append(_normalize_batch(fn(sub.to_batch(batch_format))))
+        return BlockAccessor.concat(outs)
+    return _t
+
+
+def _normalize_batch(batch: Any) -> Block:
+    if isinstance(batch, (dict, np.ndarray, list)):
+        return batch
+    raise TypeError(
+        f"map_batches fn must return dict/ndarray/list, got {type(batch)}")
+
+
+def _shuffle_transform(seed: int) -> _Transform:
+    def _t(block: Block, idx: int) -> Block:
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        # seed per (epoch seed, block index): a single seed would permute
+        # every same-size block identically, correlating rows across blocks
+        perm = np.random.default_rng((seed, idx)).permutation(n)
+        if isinstance(block, dict):
+            return {k: v[perm] for k, v in acc.to_table().items()}
+        if isinstance(block, np.ndarray):
+            return block[perm]
+        rows = acc.to_rows()
+        return [rows[i] for i in perm]
+    return _t
+
+
+class Dataset:
+    def __init__(self, plan: _Plan):
+        self._plan = plan
+        self._last_stats: Optional[ExecStats] = None
+
+    # ---------------------------------------------------------- transforms
+    def _with_transform(self, t: Callable[[Block], Block]) -> "Dataset":
+        plan = copy.copy(self._plan)
+        plan.transforms = self._plan.transforms + [t]
+        return Dataset(plan)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._with_transform(_map_rows_transform(fn))
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        return self._with_transform(_flat_map_transform(fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._with_transform(_filter_transform(fn))
+
+    def map_batches(self, fn: Callable[[Any], Any], *,
+                    batch_format: str = "dict",
+                    batch_size: Optional[int] = None) -> "Dataset":
+        return self._with_transform(
+            _map_batches_transform(fn, batch_format, batch_size))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle block order globally + rows within each block.
+
+        An approximation of the reference's all-to-all shuffle
+        (data/_internal/planner/exchange/) that never materializes the
+        dataset — adequate for training-epoch decorrelation; not a uniform
+        global permutation.
+        """
+        rng = random.Random(seed)
+        plan = copy.copy(self._plan)
+        plan.read_fns = list(self._plan.read_fns)
+        rng.shuffle(plan.read_fns)
+        plan.transforms = self._plan.transforms + [
+            _shuffle_transform(rng.randrange(2**31))]
+        return Dataset(plan)
+
+    def limit(self, n: int) -> "Dataset":
+        plan = copy.copy(self._plan)
+        plan.limit_rows = n if plan.limit_rows is None \
+            else min(plan.limit_rows, n)
+        return Dataset(plan)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets. Each side's transform chain is baked into
+        its read thunks so the union has a single (empty) chain."""
+        def _baked(ds: "Dataset") -> List[Callable[[], Block]]:
+            fused = ds._plan.fused()
+            if fused is None:
+                return list(ds._plan.read_fns)
+
+            def bake(rf, i, _fused=fused):
+                return lambda: _fused(rf(), i)
+            return [bake(rf, i)
+                    for i, rf in enumerate(ds._plan.read_fns)]
+
+        for ds in (self, *others):
+            if ds._plan.limit_rows is not None:
+                raise ValueError("union after limit is not supported")
+        reads: List[Callable[[], Block]] = []
+        for ds in (self, *others):
+            reads.extend(_baked(ds))
+        return Dataset(_Plan(read_fns=reads,
+                             max_in_flight=self._plan.max_in_flight,
+                             ray_remote_args=dict(self._plan.ray_remote_args)))
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Round-robin block partition into n shards (reference:
+        dataset.py streaming_split's per-consumer sharding role), used to
+        give each train worker a disjoint shard."""
+        if n <= 0:
+            raise ValueError("split(n) needs n >= 1")
+        shards: List[Dataset] = []
+        for i in range(n):
+            plan = copy.copy(self._plan)
+            plan.read_fns = self._plan.read_fns[i::n]
+            plan.transforms = list(self._plan.transforms)
+            shards.append(Dataset(plan))
+        return shards
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materialize then re-slice into num_blocks near-even blocks
+        (sizes differ by at most one row; blocks are empty only when the
+        dataset has fewer rows than num_blocks)."""
+        mat = self.materialize()
+        block = BlockAccessor.concat(
+            [ray_tpu.get(r) for r in mat._refs])  # noqa: SLF001
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+
+        # Bind per-block COPIES, not a closure over the full concatenated
+        # block — otherwise every downstream task/shard would cloudpickle
+        # the entire dataset (numpy views pickle only their own elements,
+        # and deep-copying also drops the base-array reference).
+        def copy_chunk(b: Block) -> Block:
+            if isinstance(b, dict):
+                return {k: np.array(v) for k, v in b.items()}
+            if isinstance(b, np.ndarray):
+                return np.array(b)
+            return list(b)
+
+        reads = []
+        for i in range(num_blocks):
+            s, e = i * n // num_blocks, (i + 1) * n // num_blocks
+            chunk = copy_chunk(acc.slice(s, e))
+            reads.append(lambda _c=chunk: _c)
+        return Dataset(_Plan(read_fns=reads))
+
+    # ---------------------------------------------------------- execution
+    def _execute(self) -> Iterator:
+        stats = ExecStats()
+        self._last_stats = stats
+        return execute_streaming(
+            self._plan.read_fns, self._plan.fused(),
+            max_in_flight=self._plan.max_in_flight,
+            limit_rows=self._plan.limit_rows,
+            stats=stats,
+            ray_remote_args=self._plan.ray_remote_args)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "dict",
+                     drop_last: bool = False) -> Iterator[Any]:
+        """Stream exact-size batches, re-chunking across block boundaries.
+
+        Blocks are buffered as (accessor, offset) and consumed by advancing
+        the offset — table slices are numpy views, so each row is copied at
+        most once (by the concat of a boundary-straddling batch), never
+        re-concatenated per yielded batch.
+        """
+        budget = self._plan.limit_rows
+        buf: List[BlockAccessor] = []
+        head_off = 0  # consumed rows of buf[0]
+        buffered = 0
+
+        def emit(k: int) -> Block:
+            nonlocal head_off, buffered
+            parts: List[Block] = []
+            need = k
+            while need:
+                acc = buf[0]
+                avail = acc.num_rows() - head_off
+                take = min(avail, need)
+                parts.append(acc.slice(head_off, head_off + take))
+                head_off += take
+                need -= take
+                buffered -= take
+                if head_off == acc.num_rows():
+                    buf.pop(0)
+                    head_off = 0
+            merged = parts[0] if len(parts) == 1 \
+                else BlockAccessor.concat(parts)
+            return BlockAccessor.for_block(merged).to_batch(batch_format)
+
+        for block_ref, meta in self._execute():
+            block = ray_tpu.get(block_ref)
+            acc = BlockAccessor.for_block(block)
+            if budget is not None:
+                take = min(acc.num_rows(), budget)
+                acc = BlockAccessor.for_block(acc.slice(0, take))
+                budget -= take
+            if acc.num_rows():
+                buf.append(acc)
+                buffered += acc.num_rows()
+            while buffered >= batch_size:
+                yield emit(batch_size)
+            if budget is not None and budget <= 0:
+                break
+        if buffered and not drop_last:
+            yield emit(buffered)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for batch in self.iter_batches(batch_size=4096, batch_format="rows"):
+            yield from batch
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        if self._plan.limit_rows is not None:
+            return sum(1 for _ in self.iter_rows())
+        total = 0
+        for _, meta in self._execute():
+            total += meta["num_rows"]
+        return total
+
+    def schema(self) -> Any:
+        for block_ref, _ in self._execute():
+            return BlockAccessor.for_block(ray_tpu.get(block_ref)).schema()
+        return None
+
+    def materialize(self) -> "MaterializedDataset":
+        refs = [block_ref for block_ref, _ in self._execute()]
+        return MaterializedDataset(refs, limit_rows=self._plan.limit_rows)
+
+    def num_blocks(self) -> int:
+        return len(self._plan.read_fns)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._last_stats.summary() if self._last_stats else {}
+
+    def __repr__(self) -> str:
+        return (f"Dataset(num_blocks={self.num_blocks()}, "
+                f"num_transforms={len(self._plan.transforms)})")
+
+
+class MaterializedDataset(Dataset):
+    """A Dataset whose blocks already live in the object store; holding the
+    MaterializedDataset pins them (refcount via the held ObjectRefs)."""
+
+    def __init__(self, refs: List[ray_tpu.ObjectRef],
+                 limit_rows: Optional[int] = None):
+        self._refs = list(refs)
+
+        def mk(ref):
+            return lambda: ray_tpu.get(ref)
+        super().__init__(_Plan(read_fns=[mk(r) for r in self._refs],
+                               limit_rows=limit_rows))

@@ -1,0 +1,73 @@
+"""DataIterator — the train-worker-facing view of a dataset shard.
+
+Role-equivalent to the reference's DataIterator (reference:
+python/ray/data/iterator.py, surfaced in train via
+session.get_dataset_shard). TPU addition: ``iter_jax_batches`` pads the
+trailing partial batch to the full batch_size (mask column supplied) so a
+jitted train step sees one static shape for the whole epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+
+
+class DataIterator:
+    def __init__(self, dataset: Dataset):
+        self._ds = dataset
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "dict",
+                     drop_last: bool = False) -> Iterator[Any]:
+        return self._ds.iter_batches(batch_size=batch_size,
+                                     batch_format=batch_format,
+                                     drop_last=drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._ds.iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         pad_last: bool = True,
+                         mask_column: str = "__valid__",
+                         ) -> Iterator[Dict[str, np.ndarray]]:
+        """Dict-of-numpy batches with a guaranteed static leading dim.
+
+        The final partial batch is zero-padded to ``batch_size`` and a
+        boolean ``mask_column`` marks real rows — the standard trick for
+        keeping one XLA executable per epoch instead of recompiling on the
+        ragged tail.
+        """
+        for batch in self._ds.iter_batches(batch_size=batch_size,
+                                           batch_format="dict",
+                                           drop_last=False):
+            n = len(next(iter(batch.values()))) if batch else 0
+            if n == 0:
+                continue
+            if n == batch_size or not pad_last:
+                # mask present on EVERY batch (also the unpadded tail) so
+                # the epoch yields one consistent pytree structure
+                batch = dict(batch)
+                batch[mask_column] = np.ones(n, dtype=bool)
+                yield batch
+                continue
+            padded: Dict[str, np.ndarray] = {}
+            for k, v in batch.items():
+                pad_width = [(0, batch_size - n)] + [(0, 0)] * (v.ndim - 1)
+                padded[k] = np.pad(v, pad_width)
+            mask = np.zeros(batch_size, dtype=bool)
+            mask[:n] = True
+            padded[mask_column] = mask
+            yield padded
+
+    def materialize(self) -> Dataset:
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+    def __repr__(self) -> str:
+        return f"DataIterator({self._ds!r})"

@@ -13,13 +13,13 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.result import Result
 from ray_tpu.train.session import (TrainContext, get_context, report,
-                                   get_checkpoint)
+                                   get_checkpoint, get_dataset_shard)
 from ray_tpu.train.train_step import make_train_step, shard_params
 from ray_tpu.train.trainer import JaxTrainer
 
 __all__ = [
     "JaxTrainer", "RunConfig", "ScalingConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "Result", "TrainContext",
-    "get_context", "get_checkpoint", "report", "make_train_step",
-    "shard_params",
+    "get_context", "get_checkpoint", "get_dataset_shard", "report",
+    "make_train_step", "shard_params",
 ]

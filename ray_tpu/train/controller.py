@@ -46,11 +46,13 @@ class FailurePolicy:
 class TrainController:
     def __init__(self, train_fn: Callable, scaling: ScalingConfig,
                  run_config: RunConfig,
-                 train_loop_config: Optional[dict] = None):
+                 train_loop_config: Optional[dict] = None,
+                 datasets: Optional[dict] = None):
         self.train_fn = train_fn
         self.scaling = scaling
         self.run_config = run_config
         self.train_loop_config = train_loop_config
+        self.datasets = datasets
         self.state = ControllerState.INITIALIZING
         self.storage_path = run_config.resolve_storage()
         self.ckpt_manager = CheckpointManager(
@@ -72,7 +74,8 @@ class TrainController:
                     self.train_fn, self.storage_path,
                     self.train_loop_config, restore,
                     self.run_config.checkpoint_config.num_to_keep,
-                    self.run_config.checkpoint_config.checkpoint_frequency)
+                    self.run_config.checkpoint_config.checkpoint_frequency,
+                    self.datasets)
                 history.extend(per_worker[0])
                 self.state = ControllerState.FINISHED
                 return Result(

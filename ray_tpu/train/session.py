@@ -20,7 +20,8 @@ class TrainContext:
                  ckpt_manager: Optional[CheckpointManager] = None,
                  restore_from: Optional[Checkpoint] = None,
                  train_loop_config: Optional[dict] = None,
-                 checkpoint_frequency: int = 0):
+                 checkpoint_frequency: int = 0,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.rank = rank
         self.world_size = world_size
         self.storage_path = storage_path
@@ -28,6 +29,7 @@ class TrainContext:
         self.restore_from = restore_from
         self.train_loop_config = train_loop_config or {}
         self.checkpoint_frequency = checkpoint_frequency
+        self.dataset_shards = dataset_shards or {}
         self.reported: List[Dict[str, Any]] = []
         self.step = 0
 
@@ -61,6 +63,16 @@ class TrainContext:
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.restore_from
 
+    def get_dataset_shard(self, name: str = "train"):
+        """This worker's shard of JaxTrainer(datasets={name: ...}) as a
+        DataIterator (reference: train session get_dataset_shard)."""
+        if name not in self.dataset_shards:
+            raise KeyError(
+                f"no dataset {name!r} was passed to the trainer "
+                f"(have: {sorted(self.dataset_shards)})")
+        from ray_tpu.data.iterator import DataIterator
+        return DataIterator(self.dataset_shards[name])
+
 
 def _set_context(ctx: Optional[TrainContext]) -> None:
     _local.ctx = ctx
@@ -79,3 +91,7 @@ def report(metrics: Dict[str, Any], checkpoint_tree: Any = None) -> None:
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().get_dataset_shard(name)

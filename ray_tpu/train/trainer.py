@@ -19,16 +19,19 @@ class JaxTrainer:
     def __init__(self, train_loop_per_worker: Callable,
                  *, train_loop_config: Optional[dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[dict] = None):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.datasets = datasets
 
     def fit(self) -> Result:
         controller = TrainController(
             self.train_loop_per_worker, self.scaling_config,
-            self.run_config, self.train_loop_config)
+            self.run_config, self.train_loop_config,
+            datasets=self.datasets)
         result = controller.run()
         if result.error is not None:
             raise TrainingFailedError(str(result.error)) from result.error

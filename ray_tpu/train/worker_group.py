@@ -33,7 +33,8 @@ class _TrainWorker:
             train_loop_config: Optional[dict],
             restore_path: Optional[str],
             num_to_keep: Optional[int],
-            checkpoint_frequency: int = 0) -> List[dict]:
+            checkpoint_frequency: int = 0,
+            dataset_shards: Optional[dict] = None) -> List[dict]:
         ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
             storage_path=storage_path,
@@ -41,7 +42,8 @@ class _TrainWorker:
                 storage_path, num_to_keep=num_to_keep),
             restore_from=(Checkpoint(restore_path) if restore_path else None),
             train_loop_config=train_loop_config,
-            checkpoint_frequency=checkpoint_frequency)
+            checkpoint_frequency=checkpoint_frequency,
+            dataset_shards=dataset_shards)
         if restore_path:
             # Continue the step numbering of the restored run so restart
             # checkpoints never collide with (or sort below) earlier ones.
@@ -84,13 +86,36 @@ class WorkerGroup:
             train_loop_config: Optional[dict],
             restore: Optional[Checkpoint],
             num_to_keep: Optional[int],
-            checkpoint_frequency: int = 0) -> List[List[dict]]:
+            checkpoint_frequency: int = 0,
+            datasets: Optional[dict] = None) -> List[List[dict]]:
         """Execute the loop on every worker; raise WorkerGroupError on the
         first failure (reference: backend_executor re-raises worker errors)."""
+        # Disjoint per-rank dataset shards (reference: train ingest splits
+        # the dataset across workers via streaming_split).
+        shards_by_rank: List[Optional[dict]] = [None] * self.num_workers
+        if datasets:
+            def shard(ds):
+                # A rank with zero blocks would starve: a train loop with a
+                # per-batch collective (psum over the mesh) hangs when some
+                # ranks never enter it. Rebalance into one block per worker
+                # before the round-robin split; if the dataset is smaller
+                # than the worker count even that leaves an empty shard, so
+                # fail loudly instead of hanging the gang.
+                if ds.num_blocks() < self.num_workers:
+                    if ds.count() < self.num_workers:
+                        raise ValueError(
+                            f"dataset has fewer rows than num_workers="
+                            f"{self.num_workers}; some ranks would starve")
+                    ds = ds.repartition(self.num_workers)
+                return ds.split(self.num_workers)
+            per_name = {name: shard(ds) for name, ds in datasets.items()}
+            shards_by_rank = [
+                {name: shards[rank] for name, shards in per_name.items()}
+                for rank in range(self.num_workers)]
         refs = [w.run.remote(fn, storage_path, train_loop_config,
                              restore.path if restore else None, num_to_keep,
-                             checkpoint_frequency)
-                for w in self.workers]
+                             checkpoint_frequency, shards_by_rank[rank])
+                for rank, w in enumerate(self.workers)]
         # Await completions in ARRIVAL order, not rank order: a crash on
         # rank>0 must surface even while rank 0 blocks in a collective
         # (reference: backend_executor polls all workers, not worker 0).

@@ -1,0 +1,49 @@
+"""ray_tpu.data on the multiprocess cluster runtime: block payloads must
+flow worker→worker through the C++ shm object store (VERDICT round-1 item 6
+done-criterion), and Train ingest must work across real worker processes."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import data as rd
+from ray_tpu.core.worker import global_worker
+
+
+@pytest.fixture(scope="module")
+def cluster_rt():
+    rt.init(num_cpus=4, _system_config={
+        "object_store_memory_bytes": 256 * 1024 * 1024,
+        "worker_pool_prestart": 2,
+    })
+    yield rt
+    rt.shutdown()
+
+
+def test_blocks_flow_through_shm(cluster_rt):
+    n = 200_000  # float64 blocks ≫ the inline threshold → shm-sealed
+    ds = rd.from_numpy(np.arange(n, dtype=np.float64), num_blocks=4) \
+        .map_batches(lambda a: a * 2.0, batch_format="numpy")
+    mat = ds.materialize()
+    store = global_worker.backend.object_plane.store
+    assert any(store.contains(ref.id().binary()) for ref in mat._refs), \
+        "no materialized block found in the shm store"
+    out = np.concatenate(
+        list(mat.iter_batches(batch_size=50_000, batch_format="numpy")))
+    np.testing.assert_allclose(np.sort(out), np.arange(n) * 2.0)
+
+
+def test_trainer_dataset_over_processes(cluster_rt):
+    from ray_tpu import train
+
+    def loop(cfg):
+        it = train.get_dataset_shard("train")
+        s = sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=16))
+        train.report({"sum": s})
+
+    ds = rd.range(64, num_blocks=4)
+    trainer = train.JaxTrainer(
+        loop, scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(), datasets={"train": ds})
+    result = trainer.fit()
+    assert result.metrics["sum"] > 0
