@@ -1,0 +1,37 @@
+"""ray_tpu.tune — hyperparameter search & trial orchestration.
+
+Capability target: the reference's Ray Tune core (reference:
+python/ray/tune — Tuner.fit at tuner.py:312, TuneController at
+execution/tune_controller.py:68, ASHA at schedulers/async_hyperband.py,
+PBT at schedulers/pbt.py:221). Trials run as ray_tpu actors with reserved
+resources; on TPU clusters a trial's resources are a slice-shaped gang
+(e.g. {"TPU": 4}), which is how PBT spans multi-slice pods.
+"""
+
+from typing import Any, Dict
+
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+                                 sample_from, uniform)
+from ray_tpu.tune.trial import Trial, TrialStatus, get_session
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, TuneRunConfig, Tuner
+
+__all__ = [
+    "Tuner", "TuneConfig", "TuneRunConfig", "ResultGrid", "Trial",
+    "TrialStatus", "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "PopulationBasedTraining", "uniform", "loguniform", "randint", "choice",
+    "sample_from", "grid_search", "report", "get_checkpoint",
+]
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Any = None) -> None:
+    """Report one iteration's metrics (and optionally a checkpoint object)
+    from inside a trial (reference: tune report/session API)."""
+    get_session().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Any:
+    """The checkpoint object this trial should resume from, or None.
+    After a PBT exploit this is the *source* trial's checkpoint."""
+    return get_session().get_checkpoint()

@@ -1,0 +1,164 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Role-equivalent to the reference's TrialScheduler family (reference:
+tune/schedulers/trial_scheduler.py, async_hyperband.py ASHAScheduler,
+pbt.py:221 PopulationBasedTraining). Decisions are made per-result, between
+trial iterations — the controller delivers one result at a time per trial.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.search import resample_key
+from ray_tpu.tune.trial import Trial
+
+
+class Decision:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_experiment(self, metric: str, mode: str,
+                       param_space: Dict[str, Any]) -> None:
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self.param_space = param_space
+
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  all_trials: List[Trial]) -> str:
+        return Decision.CONTINUE
+
+    def score(self, trial_or_result) -> Optional[float]:
+        src = trial_or_result.last_result \
+            if isinstance(trial_or_result, Trial) else trial_or_result
+        v = src.get(self.metric)
+        return None if v is None else self.sign * float(v)
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference: async_hyperband.py).
+
+    Rung milestones are grace_period * reduction_factor**k. When a trial
+    reaches a milestone its score joins the rung; trials below the top
+    1/reduction_factor quantile of their rung stop immediately — no
+    synchronized brackets, so fast trials never wait on slow ones.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+        self._passed: Dict[str, set] = defaultdict(set)
+
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  all_trials: List[Trial]) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return Decision.STOP
+        s = self.score(result)
+        if s is None:
+            return Decision.CONTINUE
+        decision = Decision.CONTINUE
+        for m in self.milestones:
+            if t >= m and m not in self._passed[trial.trial_id]:
+                self._passed[trial.trial_id].add(m)
+                rung = self._rungs[m]
+                rung.append(s)
+                cutoff = self._cutoff(rung)
+                if cutoff is not None and s < cutoff:
+                    decision = Decision.STOP
+        return decision
+
+    def _cutoff(self, rung: List[float]) -> Optional[float]:
+        if len(rung) < self.rf:
+            return None  # not enough evidence at this rung yet
+        ordered = sorted(rung, reverse=True)
+        k = max(1, len(ordered) // self.rf)
+        return ordered[k - 1]
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT with truncation selection (reference: tune/schedulers/pbt.py:221).
+
+    Every ``perturbation_interval`` iterations a trial becomes ready; if it
+    sits in the bottom quantile it EXPLOITS a random top-quantile trial
+    (clone its checkpoint + config) and EXPLORES the cloned config
+    (perturb numeric keys ×1.2 / ×0.8 or resample with prob
+    ``resample_probability``). The controller performs the actual actor
+    restart when we return an exploit directive via trial._pbt_exploit.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = defaultdict(int)
+
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  all_trials: List[Trial]) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t - self._last_perturb[trial.trial_id] < self.interval:
+            return Decision.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scored = [(self.score(x), x) for x in all_trials
+                  if self.score(x) is not None]
+        if len(scored) < 2:
+            return Decision.CONTINUE
+        scored.sort(key=lambda p: p[0])
+        n = len(scored)
+        k = max(1, int(n * self.quantile))
+        bottom = [x for _, x in scored[:k]]
+        top = [x for _, x in scored[-k:]]
+        if trial in bottom and trial not in top:
+            source = self.rng.choice(top)
+            new_config = self._explore(dict(source.config))
+            # directive consumed by the controller (restart w/ clone state)
+            trial._pbt_exploit = {  # noqa: SLF001
+                "source_id": source.trial_id,
+                "checkpoint_path": source.checkpoint_path,
+                "config": new_config,
+            }
+        return Decision.CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        for key, space in self.mutations.items():
+            if self.rng.random() < self.resample_p:
+                fresh = resample_key({key: space}, key, self.rng)
+                if fresh is not None:
+                    config[key] = fresh
+                    continue
+            cur = config.get(key)
+            if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+                factor = 1.2 if self.rng.random() < 0.5 else 0.8
+                config[key] = type(cur)(cur * factor) \
+                    if isinstance(cur, float) else max(1, int(cur * factor))
+            else:
+                fresh = resample_key({key: space}, key, self.rng)
+                if fresh is not None:
+                    config[key] = fresh
+        return config

@@ -1,0 +1,192 @@
+"""ray_tpu.tune tests.
+
+Coverage model mirrors the reference's tune tests (reference:
+python/ray/tune/tests/test_tune_controller.py, test_trial_scheduler.py,
+test_trial_scheduler_pbt.py scope): variant generation, FIFO runs,
+ASHA early stopping, PBT exploit/explore beating fixed hyperparams,
+failure retry, and experiment restore.
+"""
+
+import math
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def local_rt():
+    rt.init(local_mode=True, num_cpus=8)
+    yield rt
+    rt.shutdown()
+
+
+# ------------------------------------------------------------ search spaces
+
+
+def test_generate_variants_grid_and_random():
+    from ray_tpu.tune.search import generate_variants
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.uniform(0.0, 1.0),
+        "c": "const",
+    }
+    variants = generate_variants(space, num_samples=2, seed=0)
+    assert len(variants) == 6  # 3 grid points x 2 samples
+    assert all(v["c"] == "const" for v in variants)
+    assert all(0.0 <= v["b"] <= 1.0 for v in variants)
+    assert sorted({v["a"] for v in variants}) == [1, 2, 3]
+
+
+def test_domains_sample_ranges():
+    import random
+    rng = random.Random(0)
+    assert 1 <= tune.randint(1, 10).sample(rng) < 10
+    lo = tune.loguniform(1e-4, 1e-1)
+    for _ in range(20):
+        assert 1e-4 <= lo.sample(rng) <= 1e-1
+    assert tune.choice(["x", "y"]).sample(rng) in ("x", "y")
+
+
+# ------------------------------------------------------------------- basics
+
+
+def test_fifo_runs_all_trials(local_rt):
+    def trainable(cfg):
+        for _ in range(3):
+            tune.report({"score": cfg["x"] * 2})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"))
+    grid = tuner.fit()
+    assert len(grid.trials) == 4
+    assert all(t.status == tune.TrialStatus.TERMINATED for t in grid.trials)
+    best = grid.get_best_result()
+    assert best.config["x"] == 4 and best.last_result["score"] == 8
+    rows = grid.get_dataframe()
+    assert len(rows) == 4 and all("config/x" in r for r in rows)
+
+
+def test_trial_error_surfaces_and_retries(local_rt):
+    calls = {"n": 0}
+
+    def flaky(cfg):
+        tune.report({"score": 1})
+        raise RuntimeError("trial-boom")
+
+    tuner = tune.Tuner(
+        flaky, param_space={"x": 1},
+        tune_config=tune.TuneConfig(metric="score", mode="max"))
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+    assert "trial-boom" in grid.trials[0].error
+
+
+# --------------------------------------------------------------------- ASHA
+
+
+def test_asha_stops_bad_trials_early(local_rt):
+    MAX_T = 32
+
+    def trainable(cfg):
+        for i in range(MAX_T):
+            tune.report({"score": cfg["slope"] * (i + 1)})
+
+    # Strong trials first: rung cutoffs are populated by good scores, so
+    # weak late arrivals fall below the top-1/rf quantile and stop (with
+    # ascending order ASHA would legitimately keep everything — each new
+    # arrival would be the best seen so far).
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"slope": tune.grid_search(
+            [4.0, 3.0, 2.0, 1.0, 0.4, 0.3, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(
+                max_t=MAX_T, grace_period=2, reduction_factor=2),
+            max_concurrent_trials=2))
+    grid = tuner.fit()
+    iters = {t.config["slope"]: t.iteration for t in grid.trials}
+    total = sum(iters.values())
+    assert total < 8 * MAX_T * 0.8, f"ASHA saved no work: {iters}"
+    # the best trial must have survived to (near) the end
+    assert iters[4.0] >= MAX_T - 1, iters
+    best = grid.get_best_result()
+    assert best.config["slope"] == 4.0
+
+
+# ---------------------------------------------------------------------- PBT
+
+
+def test_pbt_exploit_beats_stuck_trials(local_rt):
+    """Half the population starts with a divergent lr on a quadratic bowl;
+    PBT must clone the good trials' (x, lr) into the bad ones so EVERY
+    trial converges — without exploit the lr=1.99 trials oscillate forever
+    (reference done-criterion: PBT beats fixed hyperparams)."""
+    STEPS = 24
+
+    def trainable(cfg):
+        state = tune.get_checkpoint()
+        x = state["x"] if state else 5.0
+        lr = cfg["lr"]
+        start = state["step"] if state else 0
+        for step in range(start, STEPS):
+            x = x - lr * 2 * x  # GD on f(x) = x^2
+            tune.report({"loss": x * x},
+                        checkpoint={"x": x, "step": step + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.3, 0.3, 1.99, 1.99])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=4,
+                hyperparam_mutations={"lr": tune.uniform(0.1, 0.5)},
+                quantile_fraction=0.5,
+                seed=0),
+            max_concurrent_trials=4))
+    grid = tuner.fit()
+    losses = sorted(t.last_result["loss"] for t in grid.trials)
+    # fixed lr=1.99 ends with loss ~ (0.98^24 * 5)^2 ≈ 15; exploited trials
+    # must have copied a converging state instead
+    assert losses[-1] < 1.0, f"PBT failed to rescue stuck trials: {losses}"
+
+
+# ------------------------------------------------------------------ restore
+
+
+def test_experiment_restore_resumes(local_rt, tmp_path):
+    def trainable(cfg):
+        state = tune.get_checkpoint()
+        start = state["step"] if state else 0
+        if start == 0 and cfg["x"] == 2:
+            # first run of trial x=2 dies midway
+            tune.report({"score": 0}, checkpoint={"step": 1})
+            raise RuntimeError("mid-crash")
+        for step in range(start, 3):
+            tune.report({"score": cfg["x"] * 10 + step},
+                        checkpoint={"step": step + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=tune.TuneRunConfig(storage_path=str(tmp_path),
+                                      name="exp1"))
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+    storage = grid.storage_path
+
+    restored = tune.Tuner.restore(storage, trainable)
+    grid2 = restored.fit()
+    assert not grid2.errors
+    by_x = {t.config["x"]: t for t in grid2.trials}
+    # trial x=2 resumed from its step-1 checkpoint and finished
+    assert by_x[2].last_result["score"] == 22
+    assert by_x[2].status == tune.TrialStatus.TERMINATED
+    # finished trial x=1 kept its result without re-running
+    assert by_x[1].last_result["score"] == 12
