@@ -21,7 +21,8 @@ from ray_tpu.remote_function import _build_resources
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "resources", "memory",
-    "max_restarts", "max_task_retries", "max_concurrency", "name",
+    "max_restarts", "max_task_retries", "max_concurrency",
+    "concurrency_groups", "name",
     "namespace", "lifetime", "scheduling_strategy", "placement_group",
     "placement_group_bundle_index", "runtime_env", "_metadata",
 }
@@ -57,6 +58,17 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> "ActorHandle":
         worker = require_connected()
         opts = self._options
+        declared_groups = set(opts.get("concurrency_groups") or {})
+        for m, o in self._method_options.items():
+            g = o.get("concurrency_group")
+            if g and g not in declared_groups:
+                # undeclared groups would silently fall back to the default
+                # lane on the worker — the starvation the group exists to
+                # prevent (reference rejects these at creation too)
+                raise ValueError(
+                    f"method {m!r} uses concurrency_group={g!r} but the "
+                    f"actor declares concurrency_groups="
+                    f"{sorted(declared_groups) or '{}'}")
         actor_id = ActorID.of(worker.job_id)
         spec = ActorCreationSpec(
             actor_id=actor_id,
@@ -74,6 +86,11 @@ class ActorClass:
             max_restarts=int(opts.get("max_restarts", 0)),
             max_task_retries=int(opts.get("max_task_retries", 0)),
             max_concurrency=int(opts.get("max_concurrency", 1)),
+            concurrency_groups=dict(opts.get("concurrency_groups") or {}),
+            method_groups={
+                m: o["concurrency_group"]
+                for m, o in self._method_options.items()
+                if o.get("concurrency_group")},
             lifetime=opts.get("lifetime") or "non_detached",
             scheduling_strategy=opts.get("scheduling_strategy"),
         )

@@ -71,6 +71,12 @@ class ActorCreationSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1
+    # concurrency groups (reference: core_worker ConcurrencyGroupManager,
+    # transport/task_receiver.h): group name -> thread count; methods are
+    # routed to their group's lane so e.g. health/stats probes never queue
+    # behind long-running request handlers.
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    method_groups: Dict[str, str] = field(default_factory=dict)
     lifetime: str = "non_detached"
     scheduling_strategy: Any = None
     placement_group_id: Optional[bytes] = None

@@ -113,6 +113,8 @@ def actor_to_wire(spec: ActorCreationSpec) -> Tuple[dict, list]:
         "max_restarts": spec.max_restarts,
         "max_task_retries": spec.max_task_retries,
         "max_concurrency": spec.max_concurrency,
+        "concurrency_groups": dict(spec.concurrency_groups),
+        "method_groups": dict(spec.method_groups),
         "owner": spec.owner.binary() if spec.owner else b"",
     }
     return payload, contained

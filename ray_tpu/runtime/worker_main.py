@@ -47,19 +47,33 @@ class Executor:
         self.actor_instance: Optional[Any] = None
         self.actor_id: Optional[bytes] = None
         self._threads: List[threading.Thread] = []
+        # concurrency groups (reference: ConcurrencyGroupManager,
+        # core_worker/transport/concurrency_group_manager.h): each group
+        # gets its own queue + thread lane; methods route by name so
+        # control-plane probes never queue behind busy handler lanes.
+        self._group_queues: Dict[str, "queue.Queue"] = {}
+        self._method_groups: Dict[str, str] = {}
         self._start_threads(1)
 
-    def _start_threads(self, n: int) -> None:
-        while len(self._threads) < n:
-            t = threading.Thread(target=self._loop, daemon=True,
-                                 name=f"exec-{len(self._threads)}")
+    def _start_threads(self, n: int, q: Optional["queue.Queue"] = None,
+                       tag: str = "exec") -> None:
+        q = q if q is not None else self.queue
+        # exact-tag match (name is "<tag>-<index>"): a prefix test would
+        # over-count when one group's name prefixes another's ("a", "a-b")
+        have = sum(1 for t in self._threads
+                   if t.name.rsplit("-", 1)[0] == tag)
+        for i in range(have, n):
+            t = threading.Thread(target=self._loop, args=(q,), daemon=True,
+                                 name=f"{tag}-{i}")
             t.start()
             self._threads.append(t)
 
     # ------------------------------------------------------------- handlers
 
     def handle_push_task(self, payload, ctx):
-        self.queue.put((payload, ctx))
+        group = self._method_groups.get(payload.get("method_name") or "")
+        q = self._group_queues.get(group) if group else None
+        (q if q is not None else self.queue).put((payload, ctx))
         return DEFERRED
 
     def handle_cancel(self, payload, ctx):
@@ -75,9 +89,9 @@ class Executor:
 
     # ------------------------------------------------------------ execution
 
-    def _loop(self) -> None:
+    def _loop(self, q: "queue.Queue") -> None:
         while True:
-            item, ctx = self.queue.get()
+            item, ctx = q.get()
             try:
                 if isinstance(item, tuple) and item and \
                         item[0] == "__become_actor__":
@@ -123,6 +137,11 @@ class Executor:
             args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
             if spec.get("max_concurrency", 1) > 1:
                 self._start_threads(spec["max_concurrency"])
+            for gname, gn in (spec.get("concurrency_groups") or {}).items():
+                gq: "queue.Queue" = queue.Queue()
+                self._group_queues[gname] = gq
+                self._start_threads(max(1, int(gn)), q=gq, tag=f"cg-{gname}")
+            self._method_groups = dict(spec.get("method_groups") or {})
             self.actor_instance = cls(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()

@@ -1,0 +1,200 @@
+"""ray_tpu.serve — online serving: deployments, replicas, HTTP ingress.
+
+Capability target: the reference's Serve core loop (reference:
+python/ray/serve — serve.run at api.py:499, controller at
+_private/controller.py:84, pow-2 routing at _private/replica_scheduler/
+pow_2_scheduler.py:52, HTTP proxy at _private/proxy.py:752). The
+deployment graph (`.bind()` composition), queue-length autoscaling, and
+user_config reconfigure are supported; the TPU-specific LLM serving path
+lives in ray_tpu.llm on top of these primitives.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.controller import (CONTROLLER_NAME, SERVE_NAMESPACE,
+                                      ServeController)
+from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment", "run", "shutdown", "status", "get_app_handle",
+    "delete", "Deployment", "Application", "DeploymentHandle",
+    "DeploymentResponse", "start_http_proxy",
+]
+
+
+class Deployment:
+    """A configured (but not yet deployed) class/function — the result of
+    @serve.deployment (reference: serve/deployment.py)."""
+
+    def __init__(self, target: Union[type, Callable], config: Dict[str, Any]):
+        self._target = target
+        self._config = config
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = {**self._config, **overrides}
+        return Deployment(self._target, cfg)
+
+    @property
+    def name(self) -> str:
+        return self._config.get("name") or self._target.__name__
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+class Application:
+    """A deployment bound to init args; args may themselves be
+    Applications (model composition — child deployments become handles)."""
+
+    def __init__(self, deployment_: Deployment, args: tuple, kwargs: dict):
+        self.deployment = deployment_
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 8,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               user_config: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+    config = {
+        "name": name,
+        "num_replicas": num_replicas,
+        "max_ongoing_requests": max_ongoing_requests,
+        "resources": (ray_actor_options or {}).get("resources",
+                                                   {"CPU": 0.1}),
+        "user_config": user_config,
+        "autoscaling_config": autoscaling_config,
+    }
+    if target is not None:
+        return Deployment(target, config)
+    return lambda t: Deployment(t, config)
+
+
+# ---------------------------------------------------------------------------
+
+def _get_or_start_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except ValueError:
+        pass
+    cls = ray_tpu.remote(max_concurrency=16, name=CONTROLLER_NAME,
+                         namespace=SERVE_NAMESPACE,
+                         lifetime="detached")(ServeController)
+    handle = cls.remote()
+    # wait until it answers (also races: someone else may have created it)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(handle.status.remote(), timeout=10)
+            return handle
+        except Exception:  # noqa: BLE001
+            try:
+                return ray_tpu.get_actor(CONTROLLER_NAME,
+                                         namespace=SERVE_NAMESPACE)
+            except ValueError:
+                time.sleep(0.2)
+    raise RuntimeError("serve controller failed to start")
+
+
+def _deploy_application(controller, app: Application,
+                        seen: Dict[int, DeploymentHandle]) -> DeploymentHandle:
+    """Depth-first deploy; child Applications in init args are replaced by
+    their DeploymentHandles (reference: build_app graph flattening)."""
+    if id(app) in seen:
+        return seen[id(app)]
+
+    def resolve(v):
+        if isinstance(v, Application):
+            return _deploy_application(controller, v, seen)
+        return v
+
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    d = app.deployment
+    spec = {
+        "serialized_callable": cloudpickle.dumps(d._target),
+        "init_args": args,
+        "init_kwargs": kwargs,
+        "num_replicas": d._config["num_replicas"],
+        "max_ongoing_requests": d._config["max_ongoing_requests"],
+        "resources": d._config["resources"],
+        "user_config": d._config["user_config"],
+        "autoscaling_config": d._config["autoscaling_config"],
+    }
+    ray_tpu.get(controller.deploy.remote(d.name, spec), timeout=60)
+    handle = DeploymentHandle(controller, d.name)
+    seen[id(app)] = handle
+    return handle
+
+
+def run(app: Union[Application, Deployment], *,
+        wait_for_replicas: bool = True,
+        timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy an application; returns the ingress deployment's handle."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    controller = _get_or_start_controller()
+    handle = _deploy_application(controller, app, {})
+    if wait_for_replicas:
+        name = app.deployment.name
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(controller.status.remote(), timeout=30)
+            info = st.get(name)
+            if info and info["live_replicas"] >= min(
+                    info["target_replicas"], 1):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(f"deployment {name} has no live replicas "
+                               f"after {timeout_s}s")
+    return handle
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                   namespace=SERVE_NAMESPACE)
+    return DeploymentHandle(controller, name)
+
+
+def status() -> Dict[str, Any]:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                   namespace=SERVE_NAMESPACE)
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                   namespace=SERVE_NAMESPACE)
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=30)
+
+
+def start_http_proxy(port: int = 0) -> int:
+    """Ensure the HTTP ingress is up; returns the bound port."""
+    controller = _get_or_start_controller()
+    return ray_tpu.get(controller.ensure_proxy.remote(port), timeout=60)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass

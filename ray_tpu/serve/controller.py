@@ -1,0 +1,341 @@
+"""ServeController — deployment reconciliation + autoscaling.
+
+Role-equivalent to the reference's controller stack (reference:
+serve/_private/controller.py:84 with run_control_loop at :369,
+deployment_state.py:2339 DeploymentStateManager reconcile,
+autoscaling_state.py:82 + serve/autoscaling_policy.py:85): a single named
+actor holds target state per deployment; a reconcile thread converges
+actual replica actors to the target (start missing, stop extra, replace
+dead) and adjusts the target from observed queue lengths when an
+autoscaling config is present.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import ActorError
+from ray_tpu.serve.replica import Replica
+
+logger = logging.getLogger("ray_tpu.serve")
+
+CONTROLLER_NAME = "__serve_controller__"
+SERVE_NAMESPACE = "serve"
+
+
+class _DeploymentState:
+    def __init__(self, name: str, spec: Dict[str, Any]):
+        self.name = name
+        self.spec = spec
+        self.target_replicas = spec["num_replicas"]
+        self.replicas: List[Any] = []          # live ActorHandles
+        self.draining: List[Any] = []          # scale-down victims finishing
+        self.drain_deadline: Dict[str, float] = {}
+        self.version = 0
+        self.last_scale_ts = 0.0
+        self.last_health_ts = 0.0
+        self.deleted = False
+        # crash-loop damping (reference: DeploymentState DEPLOY_FAILED
+        # after bounded attempts): consecutive replica deaths back off the
+        # respawn exponentially and eventually mark the deployment
+        # unhealthy instead of burning a worker process per tick.
+        self.consecutive_failures = 0
+        self.backoff_until = 0.0
+        self.unhealthy_reason: Optional[str] = None
+
+
+class ServeController:
+    """Actor body. Created with max_concurrency > 1 so the reconcile
+    thread runs beside RPC handling."""
+
+    RECONCILE_PERIOD_S = 0.25
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._proxy = None
+        self._proxy_port: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True, name="serve-reconcile")
+        self._thread.start()
+
+    # ----------------------------------------------------------------- API
+
+    #: spec keys whose change requires replacing replica actors
+    _RESTART_KEYS = ("serialized_callable", "init_args", "init_kwargs",
+                     "max_ongoing_requests", "resources")
+
+    def deploy(self, name: str, spec: Dict[str, Any]) -> bool:
+        """Set/replace a deployment's target state. spec keys:
+        serialized_callable, init_args, init_kwargs, num_replicas,
+        max_ongoing_requests, resources, user_config, autoscaling_config.
+
+        Redeploys are minimally disruptive (reference deployment_state
+        version semantics): a changed callable/init/resources replaces
+        replicas; a changed user_config reconfigures them in place; a
+        changed num_replicas only scales.
+        """
+        with self._lock:
+            existing = self._deployments.get(name)
+            if existing is None:
+                self._deployments[name] = _DeploymentState(name, spec)
+                return True
+            old = existing.spec
+            existing.spec = spec
+            existing.target_replicas = spec["num_replicas"]
+            existing.deleted = False
+            existing.unhealthy_reason = None
+            existing.consecutive_failures = 0
+            existing.backoff_until = 0.0
+            if any(old.get(k) != spec.get(k) for k in self._RESTART_KEYS):
+                self._drain(existing)
+            elif old.get("user_config") != spec.get("user_config") \
+                    and spec.get("user_config") is not None:
+                for h in existing.replicas:
+                    try:
+                        h.reconfigure.remote(spec["user_config"])
+                    except Exception:  # noqa: BLE001
+                        pass
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return False
+            st.deleted = True
+            st.target_replicas = 0
+        return True
+
+    def get_routing_table(self, name: str) -> Dict[str, Any]:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return {"version": -1, "replicas": []}
+            return {"version": st.version, "replicas": list(st.replicas)}
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": st.target_replicas,
+                    "live_replicas": len(st.replicas),
+                    "draining": len(st.draining),
+                    "version": st.version,
+                    "deleted": st.deleted,
+                    "unhealthy_reason": st.unhealthy_reason,
+                } for name, st in self._deployments.items()}
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return [n for n, st in self._deployments.items()
+                    if not st.deleted]
+
+    def ensure_proxy(self, port: int) -> int:
+        """Start (once) the HTTP proxy actor; returns the bound port."""
+        with self._lock:
+            if self._proxy is not None:
+                return self._proxy_port
+            from ray_tpu.serve.proxy import HTTPProxy
+            me = ray_tpu.get_actor(CONTROLLER_NAME,
+                                   namespace=SERVE_NAMESPACE)
+            proxy_cls = ray_tpu.remote(max_concurrency=32)(HTTPProxy)
+            self._proxy = proxy_cls.remote(me, port)
+            self._proxy_port = ray_tpu.get(
+                self._proxy.bound_port.remote(), timeout=30)
+            return self._proxy_port
+
+    def graceful_shutdown(self) -> bool:
+        self._stop.set()
+        with self._lock:
+            for st in self._deployments.values():
+                st.deleted = True
+                self._drain(st)
+            self._deployments.clear()
+            if self._proxy is not None:
+                try:
+                    ray_tpu.kill(self._proxy)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._proxy = None
+        return True
+
+    # ------------------------------------------------------------ reconcile
+
+    def _drain(self, st: _DeploymentState) -> None:
+        for h in st.replicas:
+            try:
+                ray_tpu.kill(h)
+            except Exception:  # noqa: BLE001
+                pass
+        st.replicas = []
+        st.version += 1
+
+    def _start_replica(self, st: _DeploymentState):
+        spec = st.spec
+        rid = f"{st.name}#{uuid.uuid4().hex[:6]}"
+        opts = {
+            "max_concurrency": max(2, spec.get("max_ongoing_requests", 8)),
+            "concurrency_groups": {"control": 2},
+            "num_cpus": spec.get("resources", {}).get("CPU", 0.1),
+        }
+        extra = {k: v for k, v in spec.get("resources", {}).items()
+                 if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
+        cls = ray_tpu.remote(**opts)(Replica)
+        return cls.remote(st.name, rid, spec["serialized_callable"],
+                          tuple(spec.get("init_args") or ()),
+                          dict(spec.get("init_kwargs") or {}),
+                          spec.get("user_config"))
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — loop must survive anything
+                logger.exception("serve reconcile iteration failed")
+            self._stop.wait(self.RECONCILE_PERIOD_S)
+
+    MAX_CONSECUTIVE_FAILURES = 5
+    DRAIN_TIMEOUT_S = 10.0
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        now = time.monotonic()
+        for st in states:
+            self._check_replica_health(st)
+            self._autoscale(st)
+            self._process_draining(st)
+            with self._lock:
+                delta = st.target_replicas - len(st.replicas)
+                if delta > 0 and st.unhealthy_reason is None \
+                        and now >= st.backoff_until:
+                    for _ in range(delta):
+                        st.replicas.append(self._start_replica(st))
+                    st.version += 1
+                elif delta < 0:
+                    # graceful scale-down: victims leave the routing table
+                    # immediately (version bump) but keep running until
+                    # their in-flight requests finish (_process_draining)
+                    victims = st.replicas[delta:]
+                    st.replicas = st.replicas[:delta]
+                    st.version += 1
+                    deadline = now + self.DRAIN_TIMEOUT_S
+                    for h in victims:
+                        st.draining.append(h)
+                        st.drain_deadline[h.actor_id.hex()] = deadline
+                if st.deleted and not st.replicas and not st.draining:
+                    self._deployments.pop(st.name, None)
+
+    def _process_draining(self, st: _DeploymentState) -> None:
+        """Kill drained victims once idle (or past the drain deadline)."""
+        if not st.draining:
+            return
+        now = time.monotonic()
+        keep = []
+        for h in st.draining:
+            key = h.actor_id.hex()
+            idle = False
+            try:
+                ref = h.stats.remote()
+                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=1.0)
+                if ready:
+                    idle = ray_tpu.get(ref)["ongoing"] == 0
+            except Exception:  # noqa: BLE001 — dead already: reap below
+                idle = True
+            if idle or now >= st.drain_deadline.get(key, 0.0):
+                st.drain_deadline.pop(key, None)
+                try:
+                    ray_tpu.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
+            else:
+                keep.append(h)
+        st.draining = keep
+
+    HEALTH_PERIOD_S = 1.0
+
+    def _check_replica_health(self, st: _DeploymentState) -> None:
+        """Probe replicas in one batch; drop dead ones (reconcile restarts
+        them). Mirrors deployment_state's health-check transition. A slow
+        or still-constructing replica is NOT dead — only an ActorError
+        reply counts."""
+        now = time.monotonic()
+        if now - st.last_health_ts < self.HEALTH_PERIOD_S or not st.replicas:
+            return
+        st.last_health_ts = now
+        probes = [(h, h.health_check.remote()) for h in st.replicas]
+        try:
+            ready, _ = ray_tpu.wait([r for _, r in probes],
+                                    num_returns=len(probes), timeout=2.0)
+        except Exception:  # noqa: BLE001
+            return
+        ready_ids = {r.id() for r in ready}
+        dead = []
+        for h, ref in probes:
+            if ref.id() not in ready_ids:
+                continue
+            try:
+                ray_tpu.get(ref)
+            except ActorError:
+                dead.append(h)
+            except Exception:  # noqa: BLE001 — app error in user
+                pass                         # check_health: keep for now
+        if dead:
+            logger.warning("serve: %d dead replica(s) in %s",
+                           len(dead), st.name)
+            with self._lock:
+                st.replicas = [h for h in st.replicas if h not in dead]
+                st.version += 1
+                st.consecutive_failures += len(dead)
+                if st.consecutive_failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    st.unhealthy_reason = (
+                        f"{st.consecutive_failures} consecutive replica "
+                        f"failures; redeploy to retry")
+                    logger.error("serve: deployment %s marked unhealthy "
+                                 "(%s)", st.name, st.unhealthy_reason)
+                else:
+                    st.backoff_until = time.monotonic() + min(
+                        0.5 * (2 ** st.consecutive_failures), 30.0)
+        elif ready_ids and st.consecutive_failures:
+            st.consecutive_failures = 0
+            st.backoff_until = 0.0
+
+    def _autoscale(self, st: _DeploymentState) -> None:
+        cfg = st.spec.get("autoscaling_config")
+        if not cfg or st.deleted or not st.replicas:
+            return
+        now = time.monotonic()
+        if now - st.last_scale_ts < cfg.get("upscale_delay_s", 1.0):
+            return
+        total_ongoing = 0
+        polled = 0
+        for h in st.replicas:
+            try:
+                ref = h.stats.remote()
+                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=2.0)
+                if ready:
+                    total_ongoing += ray_tpu.get(ref)["ongoing"]
+                    polled += 1
+            except Exception:  # noqa: BLE001
+                pass
+        if polled == 0:
+            return
+        target_per = max(cfg.get("target_ongoing_requests", 2), 1e-6)
+        desired = int(round(total_ongoing / target_per)) or \
+            (1 if total_ongoing else 0)
+        desired = max(cfg.get("min_replicas", 1),
+                      min(cfg.get("max_replicas", 8), desired))
+        if desired != st.target_replicas:
+            logger.info("serve autoscale %s: %d -> %d (ongoing=%d)",
+                        st.name, st.target_replicas, desired, total_ongoing)
+            st.target_replicas = desired
+            st.last_scale_ts = now

@@ -1,0 +1,101 @@
+"""HTTPProxy — the HTTP ingress actor.
+
+Role-equivalent to the reference's per-node proxy (reference:
+serve/_private/proxy.py:752 HTTPProxy over uvicorn/starlette ASGI),
+rebuilt on the stdlib ThreadingHTTPServer (no external deps): routes
+``/{deployment}`` to a DeploymentHandle, JSON bodies in/out. Streaming
+responses and gRPC ingress are out of scope for the MVP.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+
+class HTTPProxy:
+    def __init__(self, controller, port: int = 0):
+        self._controller = controller
+        self._handles: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _dispatch(self, body: Any):
+                name = self.path.strip("/").split("/")[0]
+                if not name:
+                    self._reply(404, {"error": "no deployment in path"})
+                    return
+                try:
+                    handle = proxy._handle_for(name)
+                except KeyError:
+                    self._reply(404, {"error": f"no deployment {name!r}"})
+                    return
+                try:
+                    if body is None:
+                        resp = handle.remote()
+                    else:
+                        resp = handle.remote(body)
+                    result = resp.result(timeout=60.0)
+                    self._reply(200, {"result": result})
+                except Exception as e:  # noqa: BLE001 — app fault boundary
+                    self._reply(500, {"error": repr(e)})
+
+            def _reply(self, code: int, payload: dict):
+                try:
+                    data = json.dumps(payload).encode()
+                except (TypeError, ValueError):
+                    data = json.dumps(
+                        {"result": repr(payload.get("result"))}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    body = raw.decode("utf-8", "replace")
+                self._dispatch(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    def _handle_for(self, name: str):
+        with self._lock:
+            h = self._handles.get(name)
+        if h is not None:
+            return h
+        import ray_tpu
+        live = ray_tpu.get(self._controller.list_deployments.remote(),
+                           timeout=10)
+        if name not in live:
+            raise KeyError(name)
+        from ray_tpu.serve.router import DeploymentHandle
+        h = DeploymentHandle(self._controller, name)
+        with self._lock:
+            self._handles[name] = h
+        return h
+
+    def bound_port(self) -> int:
+        return self._port
+
+    def health_check(self) -> bool:
+        return True

@@ -1,0 +1,86 @@
+"""Replica — the actor that hosts one copy of a deployment's callable.
+
+Role-equivalent to the reference's replica actor (reference:
+serve/_private/replica.py): constructs the user class from its serialized
+form, tracks ongoing-request counts for the router's pow-2 choice and the
+controller's autoscaler, and exposes health/reconfigure hooks.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+
+
+class Replica:
+    def __init__(self, deployment_name: str, replica_id: str,
+                 serialized_callable: bytes, init_args: Tuple,
+                 init_kwargs: Dict[str, Any],
+                 user_config: Optional[Dict[str, Any]] = None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        target = cloudpickle.loads(serialized_callable)
+        if inspect.isclass(target):
+            self.callable = target(*init_args, **init_kwargs)
+        else:
+            if init_args or init_kwargs:
+                raise TypeError("function deployments take no init args")
+            self.callable = target
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._started = time.time()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def handle_request(self, method_name: str, args: Tuple,
+                       kwargs: Dict[str, Any]) -> Any:
+        """One request. Runs on one of the replica actor's concurrency
+        threads (max_ongoing_requests maps to actor max_concurrency)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self.callable
+            else:
+                target = getattr(self.callable, method_name, None)
+                if target is None:
+                    raise AttributeError(
+                        f"deployment {self.deployment_name} has no method "
+                        f"{method_name!r}")
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # stats/health run on the "control" concurrency group so the
+    # controller's probes never queue behind slow user requests occupying
+    # every handler lane (reference: replica system-message concurrency).
+    @ray_tpu.method(concurrency_group="control")
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"replica_id": self.replica_id,
+                    "ongoing": self._ongoing,
+                    "total": self._total,
+                    "uptime_s": time.time() - self._started}
+
+    @ray_tpu.method(concurrency_group="control")
+    def health_check(self) -> bool:
+        user_check = getattr(self.callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True
+
+    @ray_tpu.method(concurrency_group="control")
+    def reconfigure(self, user_config: Dict[str, Any]) -> bool:
+        fn = getattr(self.callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+        return True

@@ -1,0 +1,198 @@
+"""Router + DeploymentHandle: pow-2-choices replica selection.
+
+Role-equivalent to the reference's handle→router→replica-scheduler path
+(reference: serve/handle.py:701 DeploymentHandle.remote, _private/
+router.py:321, replica_scheduler/pow_2_scheduler.py:52): the caller keeps
+a local in-flight count per replica, samples two replicas uniformly and
+routes to the shorter queue — the classic load-balancing result that two
+choices get within O(1) of least-loaded without global state.
+
+Routing tables come from the controller and are refreshed lazily (age- or
+error-triggered), standing in for the reference's LongPollHost push.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the replica call (reference:
+    serve/handle.py DeploymentResponse).
+
+    ``result()`` retries through the router when the chosen replica died
+    before replying (routing tables are refreshed lazily, so a request can
+    race a replica death for up to TABLE_MAX_AGE_S) — the reference's
+    replica-scheduler failover, moved to result time because submission
+    here never fails synchronously."""
+
+    def __init__(self, ref, retry=None):
+        self._ref = ref
+        self._retry = retry
+
+    def result(self, timeout: Optional[float] = 30.0) -> Any:
+        from ray_tpu.exceptions import ActorError
+        attempts = 3
+        while True:
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout)
+            except ActorError:
+                attempts -= 1
+                if self._retry is None or attempts <= 0:
+                    raise
+                self._ref = self._retry()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class Router:
+    TABLE_MAX_AGE_S = 2.0
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._version = -1
+        self._fetched_at = 0.0
+        self._inflight: Dict[str, int] = {}  # replica actor id hex -> count
+        self._pending: list = []             # [(key, ref)] awaiting completion
+        self._pending_cv = threading.Condition(self._lock)
+        self._reaper_started = False
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            stale = force or not self._replicas \
+                or now - self._fetched_at > self.TABLE_MAX_AGE_S
+        if not stale:
+            return
+        table = ray_tpu.get(
+            self._controller.get_routing_table.remote(self._name),
+            timeout=30)
+        with self._lock:
+            if table["version"] != self._version:
+                self._replicas = table["replicas"]
+                self._version = table["version"]
+                live = {h.actor_id.hex() for h in self._replicas}
+                self._inflight = {k: v for k, v in self._inflight.items()
+                                  if k in live}
+            self._fetched_at = now
+
+    def _pick(self):
+        with self._lock:
+            if not self._replicas:
+                return None
+            if len(self._replicas) == 1:
+                return self._replicas[0]
+            a, b = random.sample(self._replicas, 2)
+            qa = self._inflight.get(a.actor_id.hex(), 0)
+            qb = self._inflight.get(b.actor_id.hex(), 0)
+            return a if qa <= qb else b
+
+    def route(self, method_name: str, args: tuple,
+              kwargs: dict) -> DeploymentResponse:
+        ref = self._submit(method_name, args, kwargs)
+
+        def retry():
+            # replica died before replying: refetch the table and resubmit
+            self._refresh(force=True)
+            return self._submit(method_name, args, kwargs)
+        return DeploymentResponse(ref, retry=retry)
+
+    def _submit(self, method_name: str, args: tuple, kwargs: dict):
+        self._refresh()
+        replica = self._pick()
+        if replica is None:
+            self._refresh(force=True)
+            replica = self._pick()
+            if replica is None:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no live replicas")
+        key = replica.actor_id.hex()
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        try:
+            ref = replica.handle_request.remote(method_name, args, kwargs)
+        except BaseException:
+            # undo the count on ANY submit failure (e.g. unpicklable args)
+            # or the estimate would inflate forever and skew pow-2 choices
+            with self._lock:
+                self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+            raise
+        self._watch_completion(key, ref)
+        return ref
+
+    def _watch_completion(self, key: str, ref) -> None:
+        """Register (key, ref) with the single reaper thread, which
+        decrements the replica's in-flight count when the reply lands
+        (one thread per router, not per request)."""
+        with self._pending_cv:
+            self._pending.append((key, ref))
+            if not self._reaper_started:
+                self._reaper_started = True
+                threading.Thread(target=self._reap_loop, daemon=True,
+                                 name=f"serve-router-{self._name}").start()
+            self._pending_cv.notify()
+
+    def _reap_loop(self) -> None:
+        while True:
+            with self._pending_cv:
+                while not self._pending:
+                    self._pending_cv.wait()
+                batch = list(self._pending)
+            try:
+                done, _ = ray_tpu.wait([r for _, r in batch],
+                                       num_returns=1, timeout=0.5,
+                                       fetch_local=False)
+            except Exception:  # noqa: BLE001 — e.g. during shutdown
+                time.sleep(0.5)
+                continue
+            if not done:
+                continue
+            done_set = {d.id() for d in done}
+            with self._pending_cv:
+                still = []
+                for key, ref in self._pending:
+                    if ref.id() in done_set:
+                        self._inflight[key] = max(
+                            0, self._inflight.get(key, 1) - 1)
+                    else:
+                        still.append((key, ref))
+                self._pending = still
+
+
+class DeploymentHandle:
+    """User-facing handle; ``h.remote(...)`` calls __call__ on a replica,
+    ``h.method.remote(...)`` calls a named method."""
+
+    def __init__(self, controller, deployment_name: str,
+                 method_name: str = "__call__"):
+        self._controller = controller
+        self._name = deployment_name
+        self._method = method_name
+        self._router = Router(controller, deployment_name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._router.route(self._method, args, kwargs)
+
+    def __getattr__(self, item: str) -> "DeploymentHandle":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        h = DeploymentHandle(self._controller, self._name, method_name=item)
+        h._router = self._router  # share in-flight state across methods
+        return h
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._controller, self._name, self._method))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._name!r}, method={self._method!r})"

@@ -135,7 +135,13 @@ class PopulationBasedTraining(TrialScheduler):
         bottom = [x for _, x in scored[:k]]
         top = [x for _, x in scored[-k:]]
         if trial in bottom and trial not in top:
-            source = self.rng.choice(top)
+            # Exploit clones the source's STATE; a source that never
+            # checkpointed has none to give — cloning would just reset the
+            # target to iteration 0 every interval.
+            eligible = [t for t in top if t.checkpoint_path is not None]
+            if not eligible:
+                return Decision.CONTINUE
+            source = self.rng.choice(eligible)
             new_config = self._explore(dict(source.config))
             # directive consumed by the controller (restart w/ clone state)
             trial._pbt_exploit = {  # noqa: SLF001

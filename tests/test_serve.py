@@ -1,0 +1,225 @@
+"""ray_tpu.serve tests on the multiprocess cluster runtime.
+
+Coverage model mirrors the reference's serve tests (reference:
+python/ray/serve/tests/test_standalone.py, test_deploy.py,
+test_autoscaling_policy.py scope): deploy/call/delete, multi-replica
+routing, composition handles, HTTP ingress round-trip, replica failure
+recovery, and queue-length autoscaling.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_rt():
+    rt.init(num_cpus=4, _system_config={
+        "object_store_memory_bytes": 128 * 1024 * 1024,
+        "worker_pool_prestart": 2,
+    })
+    yield rt
+    serve.shutdown()
+    rt.shutdown()
+
+
+def test_function_deployment_roundtrip(serve_rt):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind())
+    assert handle.remote(7).result(timeout=60) == 49
+    assert "square" in serve.status()
+
+
+def test_class_deployment_and_methods(serve_rt):
+    @serve.deployment(num_replicas=1)
+    class Greeter:
+        def __init__(self, prefix):
+            self.prefix = prefix
+
+        def __call__(self, name):
+            return f"{self.prefix} {name}"
+
+        def shout(self, name):
+            return f"{self.prefix} {name}!!"
+
+    h = serve.run(Greeter.bind("hello"))
+    assert h.remote("world").result(timeout=60) == "hello world"
+    assert h.shout.remote("tpu").result(timeout=30) == "hello tpu!!"
+
+
+def test_multi_replica_routing(serve_rt):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class PidSvc:
+        def __call__(self, _):
+            return os.getpid()
+
+    h = serve.run(PidSvc.bind())
+    pids = {h.remote(i).result(timeout=60) for i in range(20)}
+    assert len(pids) >= 2, f"pow-2 routing never spread load: {pids}"
+    assert os.getpid() not in pids
+
+
+def test_composition_child_handle(serve_rt):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, child):
+            self.child = child
+
+        def __call__(self, x):
+            return self.child.remote(x).result(timeout=30) + 1
+
+    h = serve.run(Ingress.bind(Doubler.bind()))
+    assert h.remote(10).result(timeout=60) == 21
+
+
+def test_http_proxy_roundtrip(serve_rt):
+    @serve.deployment(name="adder")
+    class Adder:
+        def __call__(self, body):
+            return {"sum": body["a"] + body["b"]}
+
+    serve.run(Adder.bind())
+    port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/adder",
+        data=json.dumps({"a": 2, "b": 40}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        payload = json.loads(resp.read())
+    assert payload["result"]["sum"] == 42
+    # unknown route -> 404
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nosuch", timeout=30)
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 404
+    assert raised
+
+
+def test_replica_failure_recovery(serve_rt):
+    @serve.deployment(name="fragile", num_replicas=1)
+    class Fragile:
+        def __call__(self, _):
+            return os.getpid()
+
+    h = serve.run(Fragile.bind())
+    pid = h.remote(0).result(timeout=60)
+    os.kill(pid, 9)
+    deadline = time.monotonic() + 45
+    new_pid = None
+    while time.monotonic() < deadline:
+        try:
+            new_pid = h.remote(0).result(timeout=15)
+            if new_pid != pid:
+                break
+        except Exception:
+            time.sleep(0.3)
+    assert new_pid is not None and new_pid != pid, \
+        "controller never replaced the dead replica"
+
+
+def test_autoscaling_up(serve_rt):
+    @serve.deployment(name="scaly", num_replicas=1,
+                      max_ongoing_requests=2,
+                      autoscaling_config={
+                          "min_replicas": 1, "max_replicas": 3,
+                          "target_ongoing_requests": 1,
+                          "upscale_delay_s": 0.2})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(1.0)
+            return "ok"
+
+    h = serve.run(Slow.bind())
+    # sustain a burst so the controller sees queued work
+    resps = [h.remote(i) for i in range(12)]
+    deadline = time.monotonic() + 30
+    grew = False
+    while time.monotonic() < deadline:
+        info = serve.status()["scaly"]
+        if info["live_replicas"] >= 2:
+            grew = True
+            break
+        time.sleep(0.25)
+    for r in resps:
+        r.result(timeout=120)
+    assert grew, f"autoscaler never scaled up: {serve.status()}"
+
+
+def test_crash_loop_marks_unhealthy(serve_rt):
+    @serve.deployment(name="broken")
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("ctor-always-fails")
+
+        def __call__(self, _):
+            return 0
+
+    serve.run(Broken.bind(), wait_for_replicas=False)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        info = serve.status().get("broken", {})
+        if info.get("unhealthy_reason"):
+            break
+        time.sleep(0.5)
+    assert "consecutive replica failures" in \
+        (serve.status()["broken"]["unhealthy_reason"] or ""), \
+        "crash-looping deployment never marked unhealthy"
+    serve.delete("broken")
+
+
+def test_user_config_reconfigure_in_place(serve_rt):
+    @serve.deployment(name="cfg", user_config={"factor": 2})
+    class Cfg:
+        def __init__(self):
+            self.factor = 1
+
+        def reconfigure(self, cfg):
+            self.factor = cfg["factor"]
+
+        def __call__(self, x):
+            return (x * self.factor, os.getpid())
+
+    h = serve.run(Cfg.bind())
+    out, pid1 = h.remote(10).result(timeout=60)
+    assert out == 20
+    # redeploy with only user_config changed: same replica process, new cfg
+    serve.run(Cfg.options(user_config={"factor": 5}).bind())
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        out, pid2 = h.remote(10).result(timeout=30)
+        if out == 50:
+            assert pid2 == pid1, "user_config change must not restart replicas"
+            return
+        time.sleep(0.2)
+    pytest.fail("reconfigure never applied")
+
+
+def test_delete_deployment(serve_rt):
+    @serve.deployment(name="gone")
+    def f(_):
+        return 1
+
+    serve.run(f.bind())
+    serve.delete("gone")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if "gone" not in serve.status():
+            return
+        time.sleep(0.2)
+    pytest.fail("deployment was not removed")
