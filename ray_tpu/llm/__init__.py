@@ -1,0 +1,16 @@
+"""ray_tpu.llm — TPU-native LLM inference: paged KV cache + continuous
+batching + serve deployment.
+
+Capability target: the reference's ray.serve.llm stack (reference:
+python/ray/llm/_internal/serve/ — vLLM engine wrapper, deployment,
+OpenAI-style router), rebuilt on JAX/Pallas instead of vLLM/CUDA:
+ops/paged_attention.py is the decode kernel, llm/engine.py the
+continuous-batching loop, llm/serve_llm.py the serve deployment.
+"""
+
+from ray_tpu.llm.cache import PageAllocator, make_kv_cache
+from ray_tpu.llm.engine import InferenceEngine
+from ray_tpu.llm.serve_llm import LLMServer
+
+__all__ = ["InferenceEngine", "LLMServer", "PageAllocator",
+           "make_kv_cache"]

@@ -1,0 +1,71 @@
+"""Paged KV-cache bookkeeping (host side).
+
+Role-equivalent to vLLM's block manager (the reference delegates paging to
+vLLM — reference: llm/_internal/serve/deployments/llm/vllm/): a free-list
+page allocator over the device-resident page pool. Page 0 is reserved as
+the scratch target for inactive batch slots, so the fixed-shape decode
+step can always write *somewhere* without corrupting live sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+
+SCRATCH_PAGE = 0
+
+
+class PageAllocator:
+    def __init__(self, total_pages: int):
+        if total_pages < 2:
+            raise ValueError("need at least 2 pages (one is scratch)")
+        self._free: List[int] = list(range(1, total_pages))
+        self.total_pages = total_pages
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p != SCRATCH_PAGE:
+                self._free.append(p)
+
+
+def make_kv_cache(cfg: LlamaConfig, total_pages: int, page_size: int,
+                  dtype=None):
+    """[n_layers, total_pages, Hkv, page_size, D] x 2, device-resident."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, total_pages, cfg.n_kv_heads, page_size,
+             cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+class SequenceState:
+    """Per-request paging state."""
+
+    def __init__(self, request_id: str, prompt: List[int],
+                 max_new_tokens: int):
+        self.request_id = request_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.generated: List[int] = []
+        self.pages: List[int] = []
+        self.slot: Optional[int] = None     # decode batch slot
+        self.done = False
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt) + len(self.generated)
+
+    def pages_needed(self, page_size: int, headroom: int = 0) -> int:
+        return -(-(self.num_tokens + headroom) // page_size)

@@ -1,0 +1,272 @@
+"""InferenceEngine — continuous batching over the paged KV cache.
+
+Role-equivalent to the reference's vLLM engine integration (reference:
+llm/_internal/serve/deployments/llm/vllm/vllm_engine.py — engine loop,
+admission, scheduling), rebuilt TPU-first:
+
+  - ONE compiled decode program: the decode batch has a fixed shape
+    (max_batch slots); empty slots point at the scratch page, so joining
+    and leaving sequences never changes the program (XLA recompiles on
+    shape change — the cardinal sin of TPU serving loops);
+  - prompts prefill one-at-a-time through a length-bucketed jit (prompt
+    padded to the next power-of-two bucket: a handful of compiles total),
+    then their K/V is written into pages and the sequence joins the
+    decode batch — i.e. decode of running sequences is never blocked for
+    longer than one prefill;
+  - pages allocate with one page of decode headroom and grow by one page
+    whenever the sequence fills its last page.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.cache import (SCRATCH_PAGE, PageAllocator, SequenceState,
+                               make_kv_cache)
+from ray_tpu.llm.model import decode_loop, prefill
+from ray_tpu.models.llama import LlamaConfig, init_params
+from ray_tpu.ops.paged_attention import write_prefill_kv
+
+
+@functools.partial(jax.jit, static_argnames=("t_page",),
+                   donate_argnames=("k_cache", "v_cache"))
+def _write_prefill_pages(k_cache, v_cache, k_all, v_all, true_len, pages,
+                         t_page):
+    """Stage the prompt K/V fully ON DEVICE and scatter into the pool.
+
+    k_all/v_all come straight from prefill (device arrays, padded length);
+    positions >= true_len are zeroed (padding garbage must not enter the
+    pool), then sliced/padded to t_page = len(pages)*page_size. No bytes
+    cross the host — a host round-trip here dominated TTFT on tunneled
+    chips. Caches are donated (no full-pool copy).
+    """
+    Tpad = k_all.shape[1]
+    mask = (jnp.arange(Tpad) < true_len)[None, :, None, None]
+    k_all = jnp.where(mask, k_all, 0)
+    v_all = jnp.where(mask, v_all, 0)
+    if t_page <= Tpad:
+        k_all, v_all = k_all[:, :t_page], v_all[:, :t_page]
+    else:
+        pad = [(0, 0), (0, t_page - Tpad), (0, 0), (0, 0)]
+        k_all, v_all = jnp.pad(k_all, pad), jnp.pad(v_all, pad)
+    return jax.vmap(write_prefill_kv, in_axes=(0, 0, 0, 0, None))(
+        k_cache, v_cache, k_all, v_all, pages)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceEngine:
+    def __init__(self, cfg: LlamaConfig, params=None, *,
+                 page_size: int = 16, total_pages: int = 256,
+                 max_batch: int = 8, max_seq_len: int = 1024,
+                 eos_token: Optional[int] = None, seed: int = 0,
+                 decode_chunk: int = 8):
+        self.cfg = cfg
+        self.params = params if params is not None \
+            else init_params(cfg, jax.random.PRNGKey(seed))
+        self.page_size = page_size
+        self.max_batch = max_batch
+        self.max_pages_per_seq = -(-max_seq_len // page_size)
+        self.eos_token = eos_token
+        # tokens decoded per device dispatch: each dispatch costs a full
+        # host<->device round trip (expensive over PCIe, brutal over a
+        # tunneled chip), so K steps ride one trip (vLLM multi-step
+        # scheduling); finished sequences overshoot at most K-1 tokens
+        self.decode_chunk = max(1, decode_chunk)
+        self.k_cache, self.v_cache = make_kv_cache(cfg, total_pages,
+                                                   page_size)
+        self.allocator = PageAllocator(total_pages)
+        self.waiting: List[SequenceState] = []
+        self.running: List[SequenceState] = []
+        self._slots: List[Optional[SequenceState]] = [None] * max_batch
+        self._req_ids = itertools.count()
+        self._lock = threading.Lock()
+        # device-side decode inputs (fixed shapes)
+        self._page_table = np.full((max_batch, self.max_pages_per_seq),
+                                   SCRATCH_PAGE, np.int32)
+        self._positions = np.zeros(max_batch, np.int32)
+        self._tokens = np.zeros(max_batch, np.int32)
+        self.stats = {"prefill_tokens": 0, "decode_steps": 0,
+                      "decode_tokens": 0, "decode_dispatches": 0}
+        self._finished_at_prefill: Dict[str, List[int]] = {}
+
+    # ------------------------------------------------------------ requests
+
+    def add_request(self, prompt: List[int], max_new_tokens: int = 32,
+                    ) -> str:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > \
+                self.max_pages_per_seq * self.page_size:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        rid = f"req-{next(self._req_ids)}"
+        with self._lock:
+            self.waiting.append(SequenceState(rid, prompt, max_new_tokens))
+        return rid
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self.waiting or self.running)
+
+    # ---------------------------------------------------------------- step
+
+    def step(self) -> Dict[str, List[int]]:
+        """Admit at most one waiting request (prefill), then one decode
+        step for the whole running batch. Returns {request_id: generated}
+        for sequences that FINISHED this step."""
+        self._admit()
+        finished = self._decode()
+        if self._finished_at_prefill:
+            finished.update(self._finished_at_prefill)
+            self._finished_at_prefill = {}
+        return finished
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> None:
+        with self._lock:
+            if not self.waiting:
+                return
+            slot = self._free_slot()
+            if slot is None:
+                return
+            seq = self.waiting[0]
+            n_pages = seq.pages_needed(self.page_size, headroom=1)
+            pages = self.allocator.alloc(n_pages)
+            if pages is None:
+                return  # no memory: wait for a finish to free pages
+            self.waiting.pop(0)
+        T = len(seq.prompt)
+        Tpad = _bucket(T)
+        tokens = np.zeros((1, Tpad), np.int32)
+        tokens[0, :T] = seq.prompt
+        logits, k_all, v_all = prefill(self.params, jnp.asarray(tokens),
+                                       jnp.int32(T), self.cfg)
+        Tpage = n_pages * self.page_size
+        pages_arr = jnp.asarray(pages, jnp.int32)
+        self.k_cache, self.v_cache = _write_prefill_pages(
+            self.k_cache, self.v_cache, k_all, v_all, jnp.int32(T),
+            pages_arr, Tpage)
+        first_tok = int(jnp.argmax(logits))
+        seq.pages = pages
+        self.stats["prefill_tokens"] += T
+        done_now = seq.max_new_tokens <= 1 \
+            or (self.eos_token is not None and first_tok == self.eos_token)
+        if done_now:
+            # first sampled token is EOS (drop it) or max_new_tokens == 1
+            # (keep it): finish without ever joining the decode batch
+            out = [] if (self.eos_token is not None
+                         and first_tok == self.eos_token) else [first_tok]
+            seq.generated = out
+            seq.done = True
+            self._finished_at_prefill[seq.request_id] = out
+            self.allocator.free(pages)
+            return
+        seq.generated.append(first_tok)
+        seq.slot = slot
+        self._slots[slot] = seq
+        with self._lock:
+            self.running.append(seq)
+        self._page_table[slot, :] = SCRATCH_PAGE
+        self._page_table[slot, :len(pages)] = pages
+        self._positions[slot] = seq.num_tokens - 1
+        self._tokens[slot] = first_tok
+
+    def _finish(self, slot: int, seq: SequenceState,
+                finished: Dict[str, List[int]]) -> None:
+        seq.done = True
+        finished[seq.request_id] = list(seq.generated)
+        self.allocator.free(seq.pages)
+        self._slots[slot] = None
+        self._page_table[slot, :] = SCRATCH_PAGE
+        with self._lock:
+            self.running.remove(seq)
+
+    def _ensure_chunk_pages(self, slot: int, seq: SequenceState,
+                            finished: Dict[str, List[int]]) -> bool:
+        """Pages for num_tokens + decode_chunk (the chunk may overshoot
+        past EOS/max_new_tokens into the sequence's own pages). False =
+        evicted for lack of cache memory."""
+        need = min(seq.pages_needed(self.page_size,
+                                    headroom=self.decode_chunk),
+                   self.max_pages_per_seq)
+        while len(seq.pages) < need:
+            extra = self.allocator.alloc(1)
+            if extra is None:
+                # out of cache: finish the sequence early (MVP policy;
+                # vLLM would preempt/swap instead)
+                self._finish(slot, seq, finished)
+                return False
+            self._page_table[slot, len(seq.pages)] = extra[0]
+            seq.pages.extend(extra)
+        return True
+
+    def _decode(self) -> Dict[str, List[int]]:
+        finished: Dict[str, List[int]] = {}
+        for slot, seq in list(enumerate(self._slots)):
+            if seq is not None:
+                self._ensure_chunk_pages(slot, seq, finished)
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        if not active:
+            return finished
+        K = self.decode_chunk
+        seq_lens = np.ones(self.max_batch, np.int32)
+        for i, s in active:
+            seq_lens[i] = s.num_tokens
+        toks_out, self.k_cache, self.v_cache, _, _ = decode_loop(
+            self.params, jnp.asarray(self._tokens),
+            jnp.asarray(self._positions),
+            self.k_cache, self.v_cache,
+            jnp.asarray(self._page_table), jnp.asarray(seq_lens),
+            K, self.cfg)
+        block = np.asarray(toks_out)               # [K, B], ONE readback
+        self.stats["decode_steps"] += K
+        self.stats["decode_tokens"] += K * len(active)
+        self.stats["decode_dispatches"] += 1
+        for slot, seq in active:
+            for j in range(K):
+                tok = int(block[j, slot])
+                if self.eos_token is not None and tok == self.eos_token:
+                    self._finish(slot, seq, finished)
+                    break
+                seq.generated.append(tok)
+                if len(seq.generated) >= seq.max_new_tokens:
+                    self._finish(slot, seq, finished)
+                    break
+            else:
+                self._tokens[slot] = int(block[K - 1, slot])
+                self._positions[slot] = seq.num_tokens - 1
+        return finished
+
+    # ------------------------------------------------------------ blocking
+
+    def generate(self, prompt: List[int], max_new_tokens: int = 32,
+                 ) -> List[int]:
+        """Synchronous single-request helper (tests, simple use)."""
+        rid = self.add_request(prompt, max_new_tokens)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            done = self.step()
+            if rid in done:
+                return done[rid]
+            if not self.has_work():
+                raise RuntimeError(f"request {rid} vanished")
+        raise TimeoutError("generate timed out")

@@ -1,0 +1,177 @@
+"""Cache-aware Llama forward passes for inference.
+
+Role-equivalent to the reference's vLLM model executor (reference:
+llm/_internal/serve/deployments/llm/vllm/ — the reference ships no model
+code in-tree), rebuilt on ray_tpu's functional Llama (models/llama.py —
+same params pytree, so training checkpoints serve directly):
+
+  - ``prefill``: full-prompt forward that RETURNS the per-layer K/V it
+    computed (to be written into the page pool) plus last-position logits;
+  - ``decode_step``: one token per sequence against the paged KV cache —
+    writes the new token's K/V into its page, then paged attention.
+
+Both are single jit programs: layers are stacked and scanned, the cache
+is a [n_layers, ...] leaf threaded through the scan.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import LlamaConfig, Params, _rmsnorm, _rope
+from ray_tpu.ops.paged_attention import paged_attention, write_decode_kv
+
+
+def _project_qkv(lp, h, cfg: LlamaConfig):
+    cd = cfg.dtype
+    B, L, _ = h.shape
+    q = (h @ lp["wq"].astype(cd)).reshape(B, L, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cd)).reshape(B, L, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lp["wv"].astype(cd)).reshape(B, L, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(lp, x, cfg: LlamaConfig):
+    cd = cfg.dtype
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(cd))
+    up = h @ lp["w_up"].astype(cd)
+    return x + ((gate * up) @ lp["w_down"].astype(cd))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill(params: Params, tokens: jax.Array, true_len: jax.Array,
+            cfg: LlamaConfig) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens [1, T] (T may be padded) → (logits [vocab], k_all, v_all).
+
+    ``true_len`` is the unpadded prompt length: logits come from position
+    true_len-1 (padding sits AFTER the real tokens, and causality means
+    padded positions never contaminate real ones — they only ever attend
+    backwards). k_all/v_all: [n_layers, T, Hkv, D] — the prompt's cache
+    entries in sequence order, ready for write_prefill_kv (caller slices
+    to true_len). Causal full attention: prompts are short relative to
+    training, and the blockwise fallback covers CPU.
+    """
+    B, T = tokens.shape
+    cd = cfg.dtype
+    x = params["embed"].astype(cd)[tokens]
+    positions = jnp.arange(T)
+
+    def layer(x, lp):
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(lp, h, cfg)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kr, vr = k, v
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kr = jnp.repeat(k, rep, axis=2)
+            vr = jnp.repeat(v, rep, axis=2)
+        qf = q.astype(jnp.float32)
+        s = jnp.einsum("blhd,bmhd->bhlm", qf, kr.astype(jnp.float32))
+        s *= cfg.head_dim ** -0.5
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, float("-inf"))
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhlm,bmhd->blhd", p, vr.astype(jnp.float32))
+        o = o.reshape(B, T, cfg.n_heads * cfg.head_dim).astype(cd)
+        x = x + (o @ lp["wo"].astype(cd))
+        x = _mlp(lp, x, cfg)
+        return x, (k[0], v[0])  # [T, Hkv, D] per layer
+
+    x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    xlast = lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0,
+                                     keepdims=False)
+    logits = jnp.einsum("d,vd->v", xlast.astype(cd),
+                        params["embed"].astype(cd),
+                        preferred_element_type=jnp.float32)
+    return logits, k_all, v_all
+
+
+def _decode_body(params: Params, tokens: jax.Array, positions: jax.Array,
+                 k_cache: jax.Array, v_cache: jax.Array,
+                 page_table: jax.Array, seq_lens: jax.Array,
+                 cfg: LlamaConfig,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for the whole running batch.
+
+    tokens [B] int32, positions [B] (0-based slot of THIS token),
+    k/v_cache [n_layers, P, Hkv, ps, D], page_table [B, max_pages],
+    seq_lens [B] (valid tokens INCLUDING this one, i.e. positions+1).
+    Returns (logits [B, vocab], new_k_cache, new_v_cache).
+
+    The caches are DONATED: without donation every step would copy the
+    multi-GB pools to apply a one-token scatter (measured 140 ms/step on
+    a 202M model vs ~4 ms with donation). Callers must treat the passed
+    cache arrays as consumed.
+    """
+    B = tokens.shape[0]
+    cd = cfg.dtype
+    x = params["embed"].astype(cd)[tokens][:, None, :]   # [B, 1, d]
+
+    def layer(x, inp):
+        lp, kc, vc = inp
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(lp, h, cfg)               # [B,1,H,D]
+        q = _rope(q, positions[:, None], cfg.rope_theta)
+        k = _rope(k, positions[:, None], cfg.rope_theta)
+        kc, vc = write_decode_kv(kc, vc, k[:, 0], v[:, 0],
+                                 page_table, positions)
+        o = paged_attention(q[:, 0], kc, vc, page_table, seq_lens)
+        o = o.reshape(B, 1, cfg.n_heads * cfg.head_dim).astype(cd)
+        x = x + (o @ lp["wo"].astype(cd))
+        x = _mlp(lp, x, cfg)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, 0].astype(cd),
+                        params["embed"].astype(cd),
+                        preferred_element_type=jnp.float32)
+    return logits, k_cache, v_cache
+
+
+#: single-step variant (tests, chunk=1 engines)
+decode_step = functools.partial(jax.jit, static_argnames=("cfg",),
+                                donate_argnames=("k_cache", "v_cache"),
+                                )(_decode_body)
+
+
+@functools.partial(jax.jit, static_argnames=("num_steps", "cfg"),
+                   donate_argnames=("k_cache", "v_cache"))
+def decode_loop(params: Params, tokens: jax.Array, positions: jax.Array,
+                k_cache: jax.Array, v_cache: jax.Array,
+                page_table: jax.Array, seq_lens: jax.Array,
+                num_steps: int, cfg: LlamaConfig):
+    """``num_steps`` greedy decode steps in ONE device program.
+
+    Multi-step scheduling: each host↔device round-trip costs real latency
+    (PCIe normally; a network tunnel here), so the engine amortizes it by
+    sampling on-device and reading back a [num_steps, B] token block per
+    dispatch instead of one [B] row per step. Sequences that hit EOS
+    mid-block keep decoding garbage into their own pages; the host
+    truncates on readback (bounded overshoot, the reference's vLLM
+    multi-step trade-off).
+
+    Returns (tokens_out [num_steps, B], k_cache, v_cache,
+    final_positions, final_seq_lens) — positions/seq_lens advance by
+    num_steps so the next block chains without host recomputation.
+    """
+    def one(carry, _):
+        tokens, positions, kc, vc, seq_lens = carry
+        logits, kc, vc = _decode_body(params, tokens, positions, kc, vc,
+                                      page_table, seq_lens, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, positions + 1, kc, vc, seq_lens + 1), nxt
+
+    (tok, positions, k_cache, v_cache, seq_lens), toks_out = lax.scan(
+        one, (tokens, positions, k_cache, v_cache, seq_lens),
+        None, length=num_steps)
+    return toks_out, k_cache, v_cache, positions, seq_lens

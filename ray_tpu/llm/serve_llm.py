@@ -1,0 +1,73 @@
+"""LLMServer — the serve deployment wrapping InferenceEngine.
+
+Role-equivalent to the reference's LLMDeployment (reference:
+llm/_internal/serve/deployments/llm/vllm/vllm_deployment.py): requests
+arriving on any of the replica's handler threads enqueue into the engine
+and block on a per-request event; a single engine thread runs the
+continuous-batching loop, so concurrent requests share decode batches.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.llm.engine import InferenceEngine
+from ray_tpu.models.llama import LlamaConfig
+
+
+class LLMServer:
+    """Use via serve:  serve.deployment(max_ongoing_requests=16)(LLMServer)
+    then .bind(cfg_kwargs...). Accepts {"prompt_ids": [...],
+    "max_tokens": N} and returns {"token_ids": [...]}."""
+
+    def __init__(self, model_config: Optional[Dict[str, Any]] = None,
+                 engine_config: Optional[Dict[str, Any]] = None):
+        cfg = LlamaConfig.tiny(**(model_config or {}))
+        self.engine = InferenceEngine(cfg, **(engine_config or {}))
+        self._results: Dict[str, List[int]] = {}
+        self._events: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            if not self.engine.has_work():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            finished = self.engine.step()
+            if finished:
+                with self._lock:
+                    for rid, toks in finished.items():
+                        self._results[rid] = toks
+                        ev = self._events.get(rid)
+                        if ev is not None:
+                            ev.set()
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = request["prompt_ids"]
+        max_tokens = int(request.get("max_tokens", 32))
+        ev = threading.Event()
+        rid = self.engine.add_request(prompt, max_tokens)
+        with self._lock:
+            self._events[rid] = ev
+            if rid in self._results:  # engine already finished it
+                ev.set()
+        self._wake.set()
+        if not ev.wait(timeout=300):
+            raise TimeoutError(f"LLM request {rid} timed out")
+        with self._lock:
+            toks = self._results.pop(rid)
+            self._events.pop(rid, None)
+        return {"token_ids": toks, "request_id": rid}
+
+    def stats(self) -> Dict[str, Any]:
+        return dict(self.engine.stats)
+
+    def check_health(self) -> None:
+        if not self._thread.is_alive():
+            raise RuntimeError("engine thread died")

@@ -137,12 +137,16 @@ class Executor:
             args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
             if spec.get("max_concurrency", 1) > 1:
                 self._start_threads(spec["max_concurrency"])
+            self.actor_instance = cls(*args, **kwargs)
+            # Group lanes start only AFTER construction: until then grouped
+            # calls route to the default queue, ordered behind this
+            # __become_actor__ item — an idle group lane running a method
+            # while __init__ is still in flight would see a None instance.
             for gname, gn in (spec.get("concurrency_groups") or {}).items():
                 gq: "queue.Queue" = queue.Queue()
                 self._group_queues[gname] = gq
                 self._start_threads(max(1, int(gn)), q=gq, tag=f"cg-{gname}")
             self._method_groups = dict(spec.get("method_groups") or {})
-            self.actor_instance = cls(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
             try:

@@ -11,7 +11,6 @@ lives in ray_tpu.llm on top of these primitives.
 
 from __future__ import annotations
 
-import copy
 import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -86,22 +85,29 @@ def _get_or_start_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
     except ValueError:
         pass
-    cls = ray_tpu.remote(max_concurrency=16, name=CONTROLLER_NAME,
-                         namespace=SERVE_NAMESPACE,
-                         lifetime="detached")(ServeController)
-    handle = cls.remote()
-    # wait until it answers (also races: someone else may have created it)
+    handle = None
+    try:
+        cls = ray_tpu.remote(max_concurrency=16, name=CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE,
+                             lifetime="detached")(ServeController)
+        handle = cls.remote()
+    except Exception:  # noqa: BLE001 — lost the name race: attach below
+        pass
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
-        try:
-            ray_tpu.get(handle.status.remote(), timeout=10)
-            return handle
-        except Exception:  # noqa: BLE001
+        if handle is not None:
             try:
-                return ray_tpu.get_actor(CONTROLLER_NAME,
-                                         namespace=SERVE_NAMESPACE)
-            except ValueError:
-                time.sleep(0.2)
+                ray_tpu.get(handle.status.remote(), timeout=10)
+                return handle
+            except Exception:  # noqa: BLE001 — ours died/lost the race
+                handle = None
+        try:
+            other = ray_tpu.get_actor(CONTROLLER_NAME,
+                                      namespace=SERVE_NAMESPACE)
+            ray_tpu.get(other.status.remote(), timeout=10)
+            return other
+        except Exception:  # noqa: BLE001
+            time.sleep(0.2)
     raise RuntimeError("serve controller failed to start")
 
 
@@ -150,12 +156,15 @@ def run(app: Union[Application, Deployment], *,
         while time.monotonic() < deadline:
             st = ray_tpu.get(controller.status.remote(), timeout=30)
             info = st.get(name)
-            if info and info["live_replicas"] >= min(
+            # ready = constructed + health-probe-confirmed; live merely
+            # means creation was submitted (a crash-looping __init__ still
+            # counts as live until the probe fails)
+            if info and info["ready_replicas"] >= min(
                     info["target_replicas"], 1):
                 break
             time.sleep(0.1)
         else:
-            raise TimeoutError(f"deployment {name} has no live replicas "
+            raise TimeoutError(f"deployment {name} has no ready replicas "
                                f"after {timeout_s}s")
     return handle
 

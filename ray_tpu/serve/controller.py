@@ -34,6 +34,8 @@ class _DeploymentState:
         self.spec = spec
         self.target_replicas = spec["num_replicas"]
         self.replicas: List[Any] = []          # live ActorHandles
+        self.ready: set = set()                # actor-id hexes that passed
+        #                                        a health probe (constructed)
         self.draining: List[Any] = []          # scale-down victims finishing
         self.drain_deadline: Dict[str, float] = {}
         self.version = 0
@@ -126,6 +128,11 @@ class ServeController:
                 name: {
                     "target_replicas": st.target_replicas,
                     "live_replicas": len(st.replicas),
+                    # constructed + probe-confirmed (live counts replicas
+                    # whose __init__ may still be running or crash-looping)
+                    "ready_replicas": sum(
+                        1 for h in st.replicas
+                        if h.actor_id.hex() in st.ready),
                     "draining": len(st.draining),
                     "version": st.version,
                     "deleted": st.deleted,
@@ -138,18 +145,33 @@ class ServeController:
                     if not st.deleted]
 
     def ensure_proxy(self, port: int) -> int:
-        """Start (once) the HTTP proxy actor; returns the bound port."""
+        """Start (once) the HTTP proxy actor; returns the bound port.
+
+        The slow parts (actor creation + 30s port wait) run outside the
+        state lock; a sentinel under the lock keeps startup single-shot.
+        """
         with self._lock:
-            if self._proxy is not None:
+            if self._proxy is not None and self._proxy_port is not None:
                 return self._proxy_port
-            from ray_tpu.serve.proxy import HTTPProxy
-            me = ray_tpu.get_actor(CONTROLLER_NAME,
-                                   namespace=SERVE_NAMESPACE)
-            proxy_cls = ray_tpu.remote(max_concurrency=32)(HTTPProxy)
-            self._proxy = proxy_cls.remote(me, port)
-            self._proxy_port = ray_tpu.get(
-                self._proxy.bound_port.remote(), timeout=30)
-            return self._proxy_port
+            starting = self._proxy is not None
+        if starting:  # another thread is mid-startup: wait for the port
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._proxy_port is not None:
+                        return self._proxy_port
+                time.sleep(0.1)
+            raise TimeoutError("proxy startup in progress but stuck")
+        from ray_tpu.serve.proxy import HTTPProxy
+        me = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        proxy_cls = ray_tpu.remote(max_concurrency=32)(HTTPProxy)
+        proxy = proxy_cls.remote(me, port)
+        with self._lock:
+            self._proxy = proxy
+        bound = ray_tpu.get(proxy.bound_port.remote(), timeout=30)
+        with self._lock:
+            self._proxy_port = bound
+        return bound
 
     def graceful_shutdown(self) -> bool:
         self._stop.set()
@@ -169,12 +191,18 @@ class ServeController:
     # ------------------------------------------------------------ reconcile
 
     def _drain(self, st: _DeploymentState) -> None:
-        for h in st.replicas:
+        # draining victims included: _drain is the hard-stop path
+        # (redeploy/shutdown) and the reconcile loop that would otherwise
+        # reap them may be stopping too
+        for h in st.replicas + st.draining:
             try:
                 ray_tpu.kill(h)
             except Exception:  # noqa: BLE001
                 pass
         st.replicas = []
+        st.draining = []
+        st.drain_deadline.clear()
+        st.ready.clear()
         st.version += 1
 
     def _start_replica(self, st: _DeploymentState):
@@ -216,12 +244,18 @@ class ServeController:
             self._process_draining(st)
             with self._lock:
                 delta = st.target_replicas - len(st.replicas)
-                if delta > 0 and st.unhealthy_reason is None \
-                        and now >= st.backoff_until:
-                    for _ in range(delta):
-                        st.replicas.append(self._start_replica(st))
+            if delta > 0 and st.unhealthy_reason is None \
+                    and now >= st.backoff_until:
+                # create OUTSIDE the lock (head RPC per replica — holding
+                # the lock here would stall every router's
+                # get_routing_table for the whole scale-up)
+                fresh = [self._start_replica(st) for _ in range(delta)]
+                with self._lock:
+                    st.replicas.extend(fresh)
                     st.version += 1
-                elif delta < 0:
+            with self._lock:
+                delta = st.target_replicas - len(st.replicas)
+                if delta < 0:
                     # graceful scale-down: victims leave the routing table
                     # immediately (version bump) but keep running until
                     # their in-flight requests finish (_process_draining)
@@ -285,8 +319,10 @@ class ServeController:
                 continue
             try:
                 ray_tpu.get(ref)
+                st.ready.add(h.actor_id.hex())
             except ActorError:
                 dead.append(h)
+                st.ready.discard(h.actor_id.hex())
             except Exception:  # noqa: BLE001 — app error in user
                 pass                         # check_health: keep for now
         if dead:
@@ -316,15 +352,23 @@ class ServeController:
         now = time.monotonic()
         if now - st.last_scale_ts < cfg.get("upscale_delay_s", 1.0):
             return
+        # one batched wait over all replicas (a per-replica 2s wait loop
+        # would let one stalled replica starve the whole reconcile thread)
+        probes = [(h, h.stats.remote()) for h in st.replicas]
+        try:
+            ready, _ = ray_tpu.wait([r for _, r in probes],
+                                    num_returns=len(probes), timeout=2.0)
+        except Exception:  # noqa: BLE001
+            return
+        ready_ids = {r.id() for r in ready}
         total_ongoing = 0
         polled = 0
-        for h in st.replicas:
+        for h, ref in probes:
+            if ref.id() not in ready_ids:
+                continue
             try:
-                ref = h.stats.remote()
-                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=2.0)
-                if ready:
-                    total_ongoing += ray_tpu.get(ref)["ongoing"]
-                    polled += 1
+                total_ongoing += ray_tpu.get(ref)["ongoing"]
+                polled += 1
             except Exception:  # noqa: BLE001
                 pass
         if polled == 0:

@@ -38,6 +38,11 @@ class HTTPProxy:
                 except KeyError:
                     self._reply(404, {"error": f"no deployment {name!r}"})
                     return
+                except Exception as e:  # noqa: BLE001 — controller slow/
+                    # unreachable: a JSON 503 beats a dropped connection
+                    self._reply(503, {"error": f"routing unavailable: "
+                                               f"{e!r}"})
+                    return
                 try:
                     if body is None:
                         resp = handle.remote()

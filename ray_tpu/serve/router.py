@@ -194,5 +194,16 @@ class DeploymentHandle:
         return (DeploymentHandle,
                 (self._controller, self._name, self._method))
 
+    # Handles are value-equal by target: deploy() compares old vs new
+    # init_args to decide whether a redeploy must restart replicas, and a
+    # fresh handle to the same deployment must not read as a change.
+    def __eq__(self, other):
+        return (isinstance(other, DeploymentHandle)
+                and self._name == other._name
+                and self._method == other._method)
+
+    def __hash__(self):
+        return hash((self._name, self._method))
+
     def __repr__(self):
         return f"DeploymentHandle({self._name!r}, method={self._method!r})"

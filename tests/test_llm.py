@@ -1,0 +1,143 @@
+"""LLM inference tests: paged attention numerics, engine-vs-oracle greedy
+decoding, continuous batching invariance, page recycling.
+
+The reference has no in-tree equivalent (vLLM does this on GPU); the
+oracle here is the training-path Llama forward (models/llama.py) run
+autoregressively on the full sequence each step — the engine's paged
+incremental path must reproduce its greedy choices exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm import InferenceEngine
+from ray_tpu.llm.cache import PageAllocator
+from ray_tpu.models.llama import LlamaConfig, forward, init_params
+from ray_tpu.ops.paged_attention import (_paged_attention_pallas,
+                                         paged_attention_reference)
+
+CFG = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(7))
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def test_paged_attention_reference_matches_dense():
+    key = jax.random.PRNGKey(0)
+    B, Hq, Hkv, D, ps, P = 2, 8, 4, 64, 8, 10
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D))
+    kp = jax.random.normal(ks[1], (P, Hkv, ps, D))
+    vp = jax.random.normal(ks[2], (P, Hkv, ps, D))
+    pt = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    sl = jnp.array([11, 24], jnp.int32)
+    out = paged_attention_reference(q, kp, vp, pt, sl)
+    for b in range(B):
+        k = kp[pt[b]].transpose(1, 0, 2, 3).reshape(Hkv, -1, D)[:, :sl[b]]
+        v = vp[pt[b]].transpose(1, 0, 2, 3).reshape(Hkv, -1, D)[:, :sl[b]]
+        qg = q[b].reshape(Hkv, Hq // Hkv, D)
+        s = jnp.einsum("gqd,gtd->gqt", qg, k) * D ** -0.5
+        o = jnp.einsum("gqt,gtd->gqd",
+                       jax.nn.softmax(s, -1), v).reshape(Hq, D)
+        np.testing.assert_allclose(out[b], o, atol=1e-5)
+
+
+def test_paged_attention_pallas_interpret_matches_reference():
+    key = jax.random.PRNGKey(3)
+    B, Hq, Hkv, D, ps, P = 3, 8, 4, 128, 16, 12
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+    kp = jax.random.normal(ks[1], (P, Hkv, ps, D), jnp.float32)
+    vp = jax.random.normal(ks[2], (P, Hkv, ps, D), jnp.float32)
+    pt = jnp.array([[0, 1, 2], [3, 4, 5], [6, 7, 8]], jnp.int32)
+    sl = jnp.array([5, 33, 48], jnp.int32)
+    ref = paged_attention_reference(q, kp, vp, pt, sl)
+    out = _paged_attention_pallas(q, kp, vp, pt, sl, D ** -0.5,
+                                  interpret=True)
+    # tolerance covers MXU-emulation dot precision, not logic
+    np.testing.assert_allclose(out, ref, atol=2e-2)
+
+
+# ------------------------------------------------------------------ engine
+
+
+def _oracle_greedy(params, prompt, n_tokens):
+    """Autoregressive greedy decode via the full training forward."""
+    toks = list(prompt)
+    for _ in range(n_tokens):
+        logits = forward(params, jnp.asarray([toks]), CFG)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_engine_matches_full_forward_greedy(params):
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128)
+    prompt = [5, 17, 42, 9, 100, 3, 77]
+    got = eng.generate(prompt, max_new_tokens=12)
+    want = _oracle_greedy(params, prompt, 12)
+    assert got == want, f"paged decode diverged: {got} vs {want}"
+
+
+def test_engine_prompt_padding_invariance(params):
+    # prompt lengths around the bucket/page boundaries must not matter
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128)
+    for plen in (1, 7, 8, 9, 16, 17):
+        prompt = [(3 * i + 1) % CFG.vocab_size for i in range(plen)]
+        got = eng.generate(prompt, max_new_tokens=6)
+        want = _oracle_greedy(params, prompt, 6)
+        assert got == want, f"len {plen}: {got} vs {want}"
+
+
+def test_continuous_batching_invariance(params):
+    """Interleaved requests must produce exactly what each produces alone
+    (continuous batching must not leak state across slots)."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=128,
+                          max_batch=4, max_seq_len=128)
+    prompts = [[11, 22, 33], [101, 5], [60, 61, 62, 63, 64]]
+    solo = [_oracle_greedy(params, p, 8) for p in prompts]
+    rids = [eng.add_request(p, 8) for p in prompts]
+    results = {}
+    for _ in range(200):
+        results.update(eng.step())
+        if len(results) == len(rids):
+            break
+    for rid, want in zip(rids, solo):
+        assert results[rid] == want, f"{rid}: {results[rid]} vs {want}"
+    # batches actually shared decode dispatches (continuous batching +
+    # multi-step chunking: far fewer device round-trips than tokens)
+    assert eng.stats["decode_dispatches"] < sum(len(s) for s in solo) // 2
+
+
+def test_eos_stops_and_pages_recycle(params):
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=16,
+                          max_batch=2, max_seq_len=64)
+    free0 = eng.allocator.num_free
+    prompt = [5, 17, 42]
+    first = _oracle_greedy(params, prompt, 3)
+    eos = first[2]
+    # greedy tiny models repeat tokens: expected output is the oracle
+    # stream truncated at the FIRST occurrence of eos
+    want = first[:first.index(eos)] if eos in first else first
+    eng.eos_token = eos
+    got = eng.generate(prompt, max_new_tokens=10)
+    assert got == want, f"eos not honored: {got} vs {want}"
+    assert eng.allocator.num_free == free0, "pages leaked after finish"
+
+
+def test_page_allocator():
+    a = PageAllocator(8)
+    assert a.num_free == 7  # page 0 reserved
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3 and 0 not in got
+    assert a.alloc(10) is None
+    a.free(got)
+    assert a.num_free == 7
