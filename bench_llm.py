@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.llm import InferenceEngine
-from ray_tpu.models.llama import LlamaConfig, num_params
+from ray_tpu.models.llama import LlamaConfig
 
 
 def main() -> None:

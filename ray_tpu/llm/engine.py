@@ -112,6 +112,14 @@ class InferenceEngine:
         if len(prompt) + max_new_tokens > \
                 self.max_pages_per_seq * self.page_size:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        seq = SequenceState("probe", prompt, max_new_tokens)
+        if seq.pages_needed(self.page_size, headroom=1) > \
+                self.allocator.total_pages - 1:
+            # unsatisfiable even with an empty pool: reject now rather
+            # than spinning _admit forever at the head of the queue
+            raise ValueError(
+                f"prompt needs more pages than the cache holds "
+                f"({self.allocator.total_pages - 1} allocatable)")
         rid = f"req-{next(self._req_ids)}"
         with self._lock:
             self.waiting.append(SequenceState(rid, prompt, max_new_tokens))

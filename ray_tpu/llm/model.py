@@ -72,13 +72,8 @@ def prefill(params: Params, tokens: jax.Array, true_len: jax.Array,
             rep = cfg.n_heads // cfg.n_kv_heads
             kr = jnp.repeat(k, rep, axis=2)
             vr = jnp.repeat(v, rep, axis=2)
-        qf = q.astype(jnp.float32)
-        s = jnp.einsum("blhd,bmhd->bhlm", qf, kr.astype(jnp.float32))
-        s *= cfg.head_dim ** -0.5
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, float("-inf"))
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhlm,bmhd->blhd", p, vr.astype(jnp.float32))
+        from ray_tpu.parallel.attention import attention
+        o = attention(q, kr, vr, causal=True)
         o = o.reshape(B, T, cfg.n_heads * cfg.head_dim).astype(cd)
         x = x + (o @ lp["wo"].astype(cd))
         x = _mlp(lp, x, cfg)

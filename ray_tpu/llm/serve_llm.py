@@ -27,6 +27,7 @@ class LLMServer:
         self.engine = InferenceEngine(cfg, **(engine_config or {}))
         self._results: Dict[str, List[int]] = {}
         self._events: Dict[str, threading.Event] = {}
+        self._abandoned: set = set()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -43,6 +44,9 @@ class LLMServer:
             if finished:
                 with self._lock:
                     for rid, toks in finished.items():
+                        if rid in self._abandoned:
+                            self._abandoned.discard(rid)
+                            continue
                         self._results[rid] = toks
                         ev = self._events.get(rid)
                         if ev is not None:
@@ -59,6 +63,12 @@ class LLMServer:
                 ev.set()
         self._wake.set()
         if not ev.wait(timeout=300):
+            # the engine will still finish the request eventually; mark it
+            # abandoned so _loop drops the late result instead of leaking
+            # it (and the event) forever
+            with self._lock:
+                self._events.pop(rid, None)
+                self._abandoned.add(rid)
             raise TimeoutError(f"LLM request {rid} timed out")
         with self._lock:
             toks = self._results.pop(rid)

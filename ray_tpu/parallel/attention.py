@@ -12,7 +12,8 @@ import jax
 import jax.numpy as jnp
 
 
-def causal_attention(q, k, v, sm_scale: Optional[float] = None) -> jax.Array:
+def attention(q, k, v, sm_scale: Optional[float] = None,
+              causal: bool = True) -> jax.Array:
     """q/k/v: [B, L, H, D] → [B, L, H, D] fp32.
 
     Matmuls keep the input dtype (bf16 on the MXU) with fp32 ACCUMULATION
@@ -23,9 +24,14 @@ def causal_attention(q, k, v, sm_scale: Optional[float] = None) -> jax.Array:
         sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q * q.dtype.type(sm_scale), k,
                    preferred_element_type=jnp.float32)
-    Lq, Lk = q.shape[1], k.shape[1]
-    mask = jnp.tril(jnp.ones((Lq, Lk), bool))
-    s = jnp.where(mask[None, None], s, float("-inf"))
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Lq, Lk), bool))
+        s = jnp.where(mask[None, None], s, float("-inf"))
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32)
+
+
+def causal_attention(q, k, v, sm_scale: Optional[float] = None) -> jax.Array:
+    return attention(q, k, v, sm_scale, causal=True)

@@ -17,16 +17,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ray_tpu.parallel.attention import causal_attention
+from ray_tpu.parallel.attention import attention
 from ray_tpu.parallel.mesh import shard_map_compat
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = True,
                       sm_scale: Optional[float] = None) -> jax.Array:
-    """Call INSIDE shard_map. q/k/v: [B, seq_local, H, D]; H % axis_size == 0."""
-    if not causal:
-        raise NotImplementedError("ulysses_attention is causal-only for now")
+    """Call INSIDE shard_map. q/k/v: [B, seq_local, H, D]; H % axis_size == 0.
+
+    Works causal or bidirectional: the all-to-all regathers the FULL
+    sequence per head group, so masking is purely local.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     n = lax.psum(1, axis_name)  # static under shard_map
@@ -37,16 +39,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # [B, L/n, H, D] -> [B, L, H/n, D]: gather seq, scatter heads.
     qg, kg, vg = (a2a(x, split_axis=2, concat_axis=1) for x in (q, k, v))
-    og = causal_attention(qg, kg, vg, sm_scale)
+    og = attention(qg, kg, vg, sm_scale, causal=causal)
     # [B, L, H/n, D] -> [B, L/n, H, D]
     return a2a(og, split_axis=1, concat_axis=2).astype(q.dtype)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, *, seq_axis: str = "sp",
                               head_axis: str = "tp",
-                              batch_axes=("dp", "fsdp")) -> jax.Array:
+                              batch_axes=("dp", "fsdp"),
+                              causal: bool = True) -> jax.Array:
     spec = P(batch_axes, seq_axis, head_axis, None)
     fn = shard_map_compat(
-        functools.partial(ulysses_attention, axis_name=seq_axis),
+        functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -1,0 +1,21 @@
+"""ray_tpu.rllib — RL training: EnvRunner actors + jitted PPO learner.
+
+Capability target: the reference's RLlib new-API-stack core loop
+(reference: rllib/algorithms/algorithm.py:199, core/learner/learner.py:111,
+env/single_agent_env_runner.py:66), TPU-first: the learner is one pjit
+program (GAE + clipped PPO over scanned minibatch epochs) that dp-shards
+over a mesh; rollouts run on CPU actors and sync weights via the object
+store.
+"""
+
+from ray_tpu.rllib.algorithm import PPO, PPOConfig
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVectorEnv, VectorEnv
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import PPOLearner, compute_gae
+from ray_tpu.rllib.module import forward, init_module, sample_actions
+
+__all__ = [
+    "PPO", "PPOConfig", "PPOLearner", "EnvRunner", "VectorEnv",
+    "CartPoleVectorEnv", "ENV_REGISTRY", "compute_gae", "init_module",
+    "forward", "sample_actions",
+]

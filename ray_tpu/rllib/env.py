@@ -1,0 +1,100 @@
+"""Vectorized env API + a dependency-free CartPole.
+
+Role-equivalent to the reference's env layer (reference:
+rllib/env/single_agent_env_runner.py:66 runs gym vector envs): a VectorEnv
+steps B environments in lockstep with numpy arrays — auto-resetting done
+envs, the convention the runner's trajectory collection assumes.
+CartPole-v1 dynamics reimplemented in numpy (no gym in the image).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+class VectorEnv:
+    num_envs: int
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed: int = 0) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict[str, Any]]:
+        """actions [B] -> (obs [B, D], rewards [B], dones [B], info).
+        Done envs auto-reset; obs is the NEW episode's first obs."""
+        raise NotImplementedError
+
+
+class CartPoleVectorEnv(VectorEnv):
+    """CartPole-v1 physics (standard constants), vectorized.
+
+    Episode ends when |x| > 2.4, |theta| > 12deg, or 500 steps; reward 1
+    per step. Solved threshold ~475.
+    """
+
+    GRAVITY = 9.8
+    MASS_CART = 1.0
+    MASS_POLE = 0.1
+    LENGTH = 0.5           # half pole length
+    FORCE = 10.0
+    TAU = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int):
+        self.num_envs = num_envs
+        self.observation_dim = 4
+        self.num_actions = 2
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+        self._rng = np.random.default_rng(0)
+        self.episode_returns: list = []     # completed-episode returns
+        self._ret = np.zeros(num_envs, np.float64)
+
+    def reset(self, seed: int = 0) -> np.ndarray:
+        self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, (self.num_envs, 4))
+        self._steps[:] = 0
+        self._ret[:] = 0
+        return self._state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, th, th_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE, -self.FORCE)
+        costh, sinth = np.cos(th), np.sin(th)
+        total_mass = self.MASS_CART + self.MASS_POLE
+        pm_len = self.MASS_POLE * self.LENGTH
+        temp = (force + pm_len * th_dot ** 2 * sinth) / total_mass
+        th_acc = (self.GRAVITY * sinth - costh * temp) / (
+            self.LENGTH * (4.0 / 3.0
+                           - self.MASS_POLE * costh ** 2 / total_mass))
+        x_acc = temp - pm_len * th_acc * costh / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * x_acc
+        th = th + self.TAU * th_dot
+        th_dot = th_dot + self.TAU * th_acc
+        self._state = np.stack([x, x_dot, th, th_dot], axis=1)
+        self._steps += 1
+        self._ret += 1.0
+
+        dones = ((np.abs(x) > self.X_LIMIT)
+                 | (np.abs(th) > self.THETA_LIMIT)
+                 | (self._steps >= self.MAX_STEPS))
+        rewards = np.ones(self.num_envs, np.float32)
+        if dones.any():
+            idx = np.flatnonzero(dones)
+            self.episode_returns.extend(self._ret[idx].tolist())
+            self._state[idx] = self._rng.uniform(-0.05, 0.05,
+                                                 (len(idx), 4))
+            self._steps[idx] = 0
+            self._ret[idx] = 0
+        return (self._state.astype(np.float32), rewards,
+                dones.astype(np.bool_), {})
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv}

@@ -1,0 +1,74 @@
+"""EnvRunner — the rollout actor.
+
+Role-equivalent to the reference's SingleAgentEnvRunner (reference:
+rllib/env/single_agent_env_runner.py:66 + env_runner_group.py:71): a CPU
+actor stepping a vector env with the current policy, returning fixed-size
+trajectory batches. Weights arrive as an ObjectRef (one store write per
+sync, every runner reads the same copy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 seed: int = 0):
+        import jax
+        self._jax = jax
+        self.env = ENV_REGISTRY[env_name](num_envs)
+        self.rollout_len = rollout_len
+        self.obs = self.env.reset(seed=seed)
+        self.params = None
+        self._key = jax.random.PRNGKey(seed)
+        self._sample = jax.jit(self._make_sample())
+
+    def _make_sample(self):
+        from ray_tpu.rllib.module import sample_actions
+
+        def fn(params, obs, key):
+            return sample_actions(params, obs, key)
+        return fn
+
+    def set_weights(self, params: Any) -> bool:
+        self.params = params
+        return True
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """Collect rollout_len steps from every env.
+
+        Returns obs/actions/logp/values/rewards/dones [T, B] (+obs dims)
+        plus last_value [B] for GAE bootstrap and episode-return stats.
+        """
+        assert self.params is not None, "set_weights before sample"
+        T, B = self.rollout_len, self.env.num_envs
+        out = {
+            "obs": np.zeros((T, B, self.env.observation_dim), np.float32),
+            "actions": np.zeros((T, B), np.int32),
+            "logp": np.zeros((T, B), np.float32),
+            "values": np.zeros((T, B), np.float32),
+            "rewards": np.zeros((T, B), np.float32),
+            "dones": np.zeros((T, B), np.bool_),
+        }
+        self.env.episode_returns.clear()
+        for t in range(T):
+            self._key, sub = self._jax.random.split(self._key)
+            actions, logp, values = self._sample(self.params, self.obs, sub)
+            actions = np.asarray(actions)
+            out["obs"][t] = self.obs
+            out["actions"][t] = actions
+            out["logp"][t] = np.asarray(logp)
+            out["values"][t] = np.asarray(values)
+            self.obs, rewards, dones, _ = self.env.step(actions)
+            out["rewards"][t] = rewards
+            out["dones"][t] = dones
+        _, _, last_value = self._sample(self.params, self.obs, self._key)
+        out["last_value"] = np.asarray(last_value)
+        out["episode_returns"] = np.asarray(self.env.episode_returns,
+                                            np.float32)
+        return out

@@ -1,0 +1,136 @@
+"""PPO Learner — the jitted update program.
+
+Role-equivalent to the reference's Learner/LearnerGroup (reference:
+rllib/core/learner/learner.py:111, learner_group.py:79 — torch DDP
+learners), TPU-first: ONE pjit program does GAE + clipped-surrogate +
+value + entropy over all minibatch epochs (lax.scan over shuffled
+minibatches), dp-sharded over the mesh when one is supplied — gradient
+reduction comes from the shardings, not a DDP wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.module import forward
+
+
+def compute_gae(rewards, values, dones, last_value, *,
+                gamma: float, lam: float):
+    """[T, B] arrays -> (advantages [T, B], returns [T, B])."""
+    def step(carry, inp):
+        adv_next, v_next = carry
+        r, v, d = inp
+        nonterminal = 1.0 - d.astype(jnp.float32)
+        delta = r + gamma * v_next * nonterminal - v
+        adv = delta + gamma * lam * nonterminal * adv_next
+        return (adv, v), adv
+
+    (_, _), advs = jax.lax.scan(
+        step, (jnp.zeros_like(last_value), last_value),
+        (rewards, values, dones), reverse=True)
+    return advs, advs + values
+
+
+class PPOLearner:
+    def __init__(self, *, lr: float = 3e-4, gamma: float = 0.99,
+                 gae_lambda: float = 0.95, clip: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 num_epochs: int = 4, minibatches: int = 4,
+                 max_grad_norm: float = 0.5, mesh=None):
+        self.cfg = dict(gamma=gamma, lam=gae_lambda, clip=clip,
+                        vf=vf_coeff, ent=entropy_coeff,
+                        epochs=num_epochs, minibatches=minibatches)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm), optax.adam(lr))
+        self.mesh = mesh
+        self.opt_state = None
+        self._update = jax.jit(functools.partial(
+            self._update_impl, **self.cfg))
+
+    def init(self, params) -> None:
+        self.opt_state = self.optimizer.init(params)
+
+    def _update_impl(self, params, opt_state, batch, key, *,
+                     gamma, lam, clip, vf, ent, epochs, minibatches):
+        advs, rets = compute_gae(batch["rewards"], batch["values"],
+                                 batch["dones"], batch["last_value"],
+                                 gamma=gamma, lam=lam)
+        T, B = batch["rewards"].shape
+        N = T * B
+        flat = {
+            "obs": batch["obs"].reshape(N, -1),
+            "actions": batch["actions"].reshape(N),
+            "logp_old": batch["logp"].reshape(N),
+            "adv": advs.reshape(N),
+            "ret": rets.reshape(N),
+        }
+        flat["adv"] = (flat["adv"] - flat["adv"].mean()) / (
+            flat["adv"].std() + 1e-8)
+
+        def loss_fn(p, mb):
+            logits, value = forward(p, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = logp_all[jnp.arange(mb["obs"].shape[0]), mb["actions"]]
+            ratio = jnp.exp(logp - mb["logp_old"])
+            surr = jnp.minimum(
+                ratio * mb["adv"],
+                jnp.clip(ratio, 1 - clip, 1 + clip) * mb["adv"])
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            v_loss = 0.5 * ((value - mb["ret"]) ** 2).mean()
+            total = -surr.mean() + vf * v_loss - ent * entropy
+            return total, (v_loss, entropy)
+
+        mb_size = N // minibatches
+
+        def epoch(carry, ekey):
+            params, opt_state = carry
+            perm = jax.random.permutation(ekey, N)
+
+            def mb_step(carry, idx):
+                params, opt_state = carry
+                mb = {k: v[idx] for k, v in flat.items()}
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, mb)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            idxs = perm[:minibatches * mb_size].reshape(minibatches,
+                                                        mb_size)
+            (params, opt_state), losses = jax.lax.scan(
+                mb_step, (params, opt_state), idxs)
+            return (params, opt_state), losses.mean()
+
+        keys = jax.random.split(key, epochs)
+        (params, opt_state), losses = jax.lax.scan(
+            epoch, (params, opt_state), keys)
+        return params, opt_state, {"loss": losses.mean()}
+
+    def update(self, params, batch: Dict[str, np.ndarray], key
+               ) -> Tuple[Any, Dict[str, float]]:
+        """One PPO update from a host-side trajectory batch."""
+        if self.opt_state is None:
+            self.init(params)
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # dp-shard the env axis (dim 1 of [T, B, ...] tensors)
+            for k in ("obs", "actions", "logp", "values", "rewards",
+                      "dones"):
+                jb[k] = jax.device_put(
+                    jb[k], NamedSharding(self.mesh, P(None, ("dp", "fsdp"))))
+            jb["last_value"] = jax.device_put(
+                jb["last_value"], NamedSharding(self.mesh,
+                                                P(("dp", "fsdp"))))
+        params, self.opt_state, metrics = self._update(
+            params, self.opt_state, jb, key)
+        return params, {k: float(v) for k, v in metrics.items()}

@@ -1,0 +1,54 @@
+"""RLModule — the policy/value network as pure functions.
+
+Role-equivalent to the reference's RLModule (reference:
+rllib/core/rl_module/rl_module.py:260), functional-JAX style: init/apply
+pytrees, shared MLP torso with policy + value heads (the default
+architecture of the reference's catalog for box-obs/discrete-action).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_module(key: jax.Array, obs_dim: int, num_actions: int,
+                hidden: Tuple[int, ...] = (64, 64)) -> Params:
+    sizes = (obs_dim,) + hidden
+    params: Params = {}
+    keys = jax.random.split(key, len(hidden) + 2)
+    for i in range(len(hidden)):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5
+        params[f"b{i}"] = jnp.zeros(sizes[i + 1])
+    params["w_pi"] = jax.random.normal(
+        keys[-2], (sizes[-1], num_actions)) * 0.01
+    params["b_pi"] = jnp.zeros(num_actions)
+    params["w_v"] = jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0
+    params["b_v"] = jnp.zeros(1)
+    return params
+
+
+def forward(params: Params, obs: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """obs [B, D] -> (logits [B, A], value [B])."""
+    h = obs
+    # hidden-layer count from the key names (static under jit)
+    n = sum(1 for k in params if k[0] == "w" and k[1:].isdigit())
+    for i in range(n):
+        h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+    logits = h @ params["w_pi"] + params["b_pi"]
+    value = (h @ params["w_v"] + params["b_v"])[:, 0]
+    return logits, value
+
+
+def sample_actions(params: Params, obs: jnp.ndarray, key: jax.Array):
+    """-> (actions [B], logp [B], value [B])."""
+    logits, value = forward(params, obs)
+    actions = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), actions]
+    return actions, logp, value

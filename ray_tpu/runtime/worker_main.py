@@ -135,13 +135,15 @@ class Executor:
         try:
             cls = cloudpickle.loads(spec["cls_bytes"])
             args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
+            self.actor_instance = cls(*args, **kwargs)
+            # ALL extra lanes (default max_concurrency and groups) start
+            # only AFTER construction: until then every call sits in the
+            # default queue behind this __become_actor__ item, whose
+            # single consumer is this thread — any extra consumer could
+            # dequeue a method while __init__ is still in flight and see a
+            # None instance.
             if spec.get("max_concurrency", 1) > 1:
                 self._start_threads(spec["max_concurrency"])
-            self.actor_instance = cls(*args, **kwargs)
-            # Group lanes start only AFTER construction: until then grouped
-            # calls route to the default queue, ordered behind this
-            # __become_actor__ item — an idle group lane running a method
-            # while __init__ is still in flight would see a None instance.
             for gname, gn in (spec.get("concurrency_groups") or {}).items():
                 gq: "queue.Queue" = queue.Queue()
                 self._group_queues[gname] = gq

@@ -168,7 +168,18 @@ class ServeController:
         proxy = proxy_cls.remote(me, port)
         with self._lock:
             self._proxy = proxy
-        bound = ray_tpu.get(proxy.bound_port.remote(), timeout=30)
+        try:
+            bound = ray_tpu.get(proxy.bound_port.remote(), timeout=30)
+        except BaseException:
+            # failed startup must not wedge the sentinel: clear it so the
+            # next ensure_proxy attempt can start fresh
+            with self._lock:
+                self._proxy = None
+            try:
+                ray_tpu.kill(proxy)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
         with self._lock:
             self._proxy_port = bound
         return bound
@@ -244,6 +255,7 @@ class ServeController:
             self._process_draining(st)
             with self._lock:
                 delta = st.target_replicas - len(st.replicas)
+                version_at_plan = st.version
             if delta > 0 and st.unhealthy_reason is None \
                     and now >= st.backoff_until:
                 # create OUTSIDE the lock (head RPC per replica — holding
@@ -251,8 +263,21 @@ class ServeController:
                 # get_routing_table for the whole scale-up)
                 fresh = [self._start_replica(st) for _ in range(delta)]
                 with self._lock:
-                    st.replicas.extend(fresh)
-                    st.version += 1
+                    if st.version != version_at_plan:
+                        # a concurrent deploy() drained/changed the spec
+                        # mid-creation: these replicas were built from the
+                        # OLD spec — discard them instead of registering
+                        # stale code into the routing table
+                        stale = fresh
+                    else:
+                        st.replicas.extend(fresh)
+                        st.version += 1
+                        stale = []
+                for h in stale:
+                    try:
+                        ray_tpu.kill(h)
+                    except Exception:  # noqa: BLE001
+                        pass
             with self._lock:
                 delta = st.target_replicas - len(st.replicas)
                 if delta < 0:

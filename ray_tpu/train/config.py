@@ -31,6 +31,16 @@ class ScalingConfig:
     use_tpu: bool = False
     chips_per_worker: int = 0
     resources_per_worker: Optional[dict] = None
+    # multi-host SPMD: workers jax.distributed.initialize against one
+    # coordinator and build ONE global mesh (train/backend.py). On TPU
+    # pods leave platform/local_device_count unset (runtime discovers
+    # topology); CPU test meshes set platform="cpu" + K virtual devices
+    # per worker. coordinator_address overrides the controller's choice
+    # (needed when rank 0 runs on a different host than the driver).
+    jax_distributed: bool = False
+    jax_platform: Optional[str] = None
+    local_device_count: Optional[int] = None
+    coordinator_address: Optional[str] = None
 
     def worker_resources(self) -> dict:
         res = dict(self.resources_per_worker or {})

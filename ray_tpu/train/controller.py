@@ -65,7 +65,8 @@ class TrainController:
         while True:
             self.state = ControllerState.SCHEDULING
             group = WorkerGroup(self.scaling.num_workers,
-                                self.scaling.worker_resources())
+                                self.scaling.worker_resources(),
+                                scaling=self.scaling)
             group.start()
             try:
                 self.state = ControllerState.RUNNING

@@ -21,7 +21,8 @@ class TrainContext:
                  restore_from: Optional[Checkpoint] = None,
                  train_loop_config: Optional[dict] = None,
                  checkpoint_frequency: int = 0,
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 mesh_spec: Any = None):
         self.rank = rank
         self.world_size = world_size
         self.storage_path = storage_path
@@ -30,6 +31,7 @@ class TrainContext:
         self.train_loop_config = train_loop_config or {}
         self.checkpoint_frequency = checkpoint_frequency
         self.dataset_shards = dataset_shards or {}
+        self.mesh_spec = mesh_spec
         self.reported: List[Dict[str, Any]] = []
         self.step = 0
 
@@ -62,6 +64,12 @@ class TrainContext:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.restore_from
+
+    def global_mesh(self):
+        """The job-wide device mesh (ScalingConfig.mesh over jax.devices();
+        spans all worker processes when jax_distributed=True)."""
+        from ray_tpu.train.backend import global_mesh
+        return global_mesh(self.mesh_spec)
 
     def get_dataset_shard(self, name: str = "train"):
         """This worker's shard of JaxTrainer(datasets={name: ...}) as a

@@ -34,7 +34,15 @@ class _TrainWorker:
             restore_path: Optional[str],
             num_to_keep: Optional[int],
             checkpoint_frequency: int = 0,
-            dataset_shards: Optional[dict] = None) -> List[dict]:
+            dataset_shards: Optional[dict] = None,
+            jax_dist: Optional[dict] = None,
+            mesh_spec=None) -> List[dict]:
+        if jax_dist is not None:
+            # multi-host bootstrap BEFORE the user loop: after this,
+            # jax.devices() is the global set (reference analog:
+            # train/torch/config.py:66 process-group setup)
+            from ray_tpu.train.backend import setup_jax_worker
+            setup_jax_worker({**jax_dist, "process_id": self.rank})
         ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
             storage_path=storage_path,
@@ -43,7 +51,8 @@ class _TrainWorker:
             restore_from=(Checkpoint(restore_path) if restore_path else None),
             train_loop_config=train_loop_config,
             checkpoint_frequency=checkpoint_frequency,
-            dataset_shards=dataset_shards)
+            dataset_shards=dataset_shards,
+            mesh_spec=mesh_spec)
         if restore_path:
             # Continue the step numbering of the restored run so restart
             # checkpoints never collide with (or sort below) earlier ones.
@@ -68,10 +77,30 @@ def _wants_arg(fn: Callable) -> bool:
 
 
 class WorkerGroup:
-    def __init__(self, num_workers: int, resources_per_worker: dict):
+    def __init__(self, num_workers: int, resources_per_worker: dict,
+                 scaling=None):
         self.num_workers = num_workers
         self.resources = resources_per_worker
+        self.scaling = scaling
         self.workers: List[Any] = []
+
+    def _jax_dist_base(self) -> Optional[dict]:
+        sc = self.scaling
+        if sc is None or not getattr(sc, "jax_distributed", False):
+            return None
+        coordinator = sc.coordinator_address
+        if coordinator is None:
+            # free port on this host; fine single-host, override via
+            # ScalingConfig.coordinator_address when rank 0 lives elsewhere
+            import socket
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+            s.close()
+        return {"coordinator": coordinator,
+                "num_processes": self.num_workers,
+                "platform": sc.jax_platform,
+                "local_device_count": sc.local_device_count}
 
     def start(self) -> None:
         cls = ray_tpu.remote(**{
@@ -112,9 +141,13 @@ class WorkerGroup:
             shards_by_rank = [
                 {name: shards[rank] for name, shards in per_name.items()}
                 for rank in range(self.num_workers)]
+        jax_dist = self._jax_dist_base()
+        mesh_spec = getattr(self.scaling, "mesh", None) \
+            if self.scaling is not None else None
         refs = [w.run.remote(fn, storage_path, train_loop_config,
                              restore.path if restore else None, num_to_keep,
-                             checkpoint_frequency, shards_by_rank[rank])
+                             checkpoint_frequency, shards_by_rank[rank],
+                             jax_dist, mesh_spec)
                 for rank, w in enumerate(self.workers)]
         # Await completions in ARRIVAL order, not rank order: a crash on
         # rank>0 must surface even while rank 0 blocks in a collective

@@ -1,0 +1,94 @@
+"""ActorPool — multiplex tasks over a fixed set of actors.
+
+Role-equivalent to the reference's ActorPool (reference:
+python/ray/util/actor_pool.py): submit(fn, value) dispatches
+fn(actor, value) to a free actor; results stream back in completion or
+submission order.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        if not actors:
+            raise ValueError("ActorPool needs at least one actor")
+        self._idle = list(actors)
+        self._pending_submits: collections.deque = collections.deque()
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef. With no free actor the call is
+        queued and dispatched when a result is consumed (reference
+        semantics: get_next frees the actor, which drains the queue)."""
+        if not self._idle:
+            self._pending_submits.append((fn, value))
+            return
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = (self._next_task_index, actor)
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def _return_actor(self, actor: Any) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.popleft()
+            self.submit(fn, value)
+
+    def get_next(self, timeout: float = 300.0) -> Any:
+        """Next result in SUBMISSION order."""
+        if self._next_return_index >= self._next_task_index:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(ref)
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: float = 300.0) -> Any:
+        """Next result in COMPLETION order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        idx, actor = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(idx, None)
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            self._return_actor(actor)
+
+    def map(self, fn: Callable[[Any, Any], Any], values) -> List[Any]:
+        """Submission-ordered map over values."""
+        out = []
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            out.append(self.get_next())
+        return out
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any], values):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor or self._pending_submits)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

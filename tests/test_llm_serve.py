@@ -34,7 +34,6 @@ def test_llm_deployment_concurrent_requests(serve_rt):
     assert all(len(o["token_ids"]) == 6 for o in outs)
     # same prompt -> same greedy tokens (engine must be deterministic)
     assert outs[0]["token_ids"] == outs[1]["token_ids"]
-    assert outs[2]["token_ids"] != outs[0]["token_ids"] or True
     stats = h.stats.remote().result(timeout=60)
     # continuous batching + chunking: 18 tokens in a handful of dispatches
     assert stats["decode_dispatches"] < 9, stats

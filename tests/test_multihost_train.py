@@ -1,0 +1,80 @@
+"""Multi-host JaxTrainer: 2 worker processes x 4 virtual CPU devices form
+ONE 8-device global mesh via jax.distributed, train tiny-Llama FSDP, and
+match the single-process loss (VERDICT round-1 item 5 done-criterion;
+reference analog: torch process-group rendezvous, train/torch/config.py:66).
+"""
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import train
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+def _make_loop():
+    """Defined inside a function so cloudpickle ships it BY VALUE (worker
+    processes cannot import the test module)."""
+    def loop(cfg):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ray_tpu.models import llama
+        from ray_tpu.train.train_step import (make_train_step, shard_batch,
+                                              shard_params)
+
+        ctx = train.get_context()
+        assert jax.process_count() == cfg["expect_processes"]
+        assert len(jax.devices()) == 8, jax.devices()
+        mesh = ctx.global_mesh()
+        assert mesh.shape["fsdp"] == 8
+
+        mcfg = llama.LlamaConfig.tiny(n_layers=2)
+        params = llama.init_params(mcfg, jax.random.PRNGKey(11))
+        with mesh:
+            params = shard_params(params, mesh, llama.param_specs(mcfg))
+            opt = optax.sgd(1e-2)
+            init_fn, step_fn = make_train_step(
+                lambda p, b: llama.loss_fn(p, b, mcfg), opt)
+            opt_state = init_fn(params)
+            rng = np.random.default_rng(11)
+            for _ in range(3):
+                batch = rng.integers(
+                    0, mcfg.vocab_size, (8, 32)).astype(np.int32)
+                batch = shard_batch(jnp.asarray(batch), mesh)
+                params, opt_state, metrics = step_fn(
+                    params, opt_state, batch)
+            train.report({"loss": float(metrics["loss"])})
+    return loop
+
+
+@pytest.fixture(scope="module")
+def cluster_rt():
+    rt.init(num_cpus=4, _system_config={
+        "object_store_memory_bytes": 128 * 1024 * 1024,
+    })
+    yield rt
+    rt.shutdown()
+
+
+def _fit(num_workers, local_devices, name):
+    trainer = train.JaxTrainer(
+        _make_loop(),
+        train_loop_config={"expect_processes": num_workers},
+        scaling_config=train.ScalingConfig(
+            num_workers=num_workers,
+            mesh=MeshSpec(fsdp=-1),
+            jax_distributed=True,
+            jax_platform="cpu",
+            local_device_count=local_devices),
+        run_config=train.RunConfig(name=name))
+    return trainer.fit()
+
+
+def test_two_process_global_mesh_matches_single(cluster_rt, tmp_path):
+    multi = _fit(2, 4, "mh2")
+    single = _fit(1, 8, "mh1")
+    assert multi.metrics["loss"] == pytest.approx(
+        single.metrics["loss"], rel=2e-4), \
+        (multi.metrics, single.metrics)

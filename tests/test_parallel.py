@@ -99,6 +99,23 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_non_causal_matches_naive(self, sp_mesh):
+        """Bidirectional path (encoders / prefix-LM): full softmax over
+        the regathered sequence, no mask."""
+        key = jax.random.PRNGKey(5)
+        kq, kk, kv = jax.random.split(key, 3)
+        B, L, H, D = 2, 32, 8, 4
+        q = jax.random.normal(kq, (B, L, H, D))
+        k = jax.random.normal(kk, (B, L, H, D))
+        v = jax.random.normal(kv, (B, L, H, D))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * D ** -0.5
+        p = jax.nn.softmax(s, axis=-1)
+        expect = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        got = jax.jit(functools.partial(
+            ulysses_attention_sharded, mesh=sp_mesh, causal=False))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-4)
+
 
 class TestPipeline:
     def test_matches_sequential(self):

@@ -1,0 +1,56 @@
+"""util extras: ActorPool + distributed Queue (reference:
+python/ray/util/actor_pool.py, util/queue.py)."""
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(scope="module")
+def local_rt():
+    rt.init(local_mode=True, num_cpus=4)
+    yield rt
+    rt.shutdown()
+
+
+def test_actor_pool_ordered_and_unordered(local_rt):
+    @rt.remote
+    class Sq:
+        def compute(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    out = pool.map(lambda a, v: a.compute.remote(v), [1, 2, 3, 4, 5])
+    assert out == [1, 4, 9, 16, 25]
+    got = sorted(pool.map_unordered(
+        lambda a, v: a.compute.remote(v), [2, 3, 4]))
+    assert got == [4, 9, 16]
+
+
+def test_queue_fifo_and_limits(local_rt):
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    from ray_tpu.util.queue import Full
+    with pytest.raises(Full):
+        q.put("c", block=False)
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    assert q.empty()
+
+
+def test_queue_across_tasks(local_rt):
+    q = Queue()
+
+    @rt.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    assert rt.get(producer.remote(q, 5), timeout=60)
+    assert [q.get(timeout=10) for _ in range(5)] == [0, 1, 2, 3, 4]
