@@ -460,6 +460,33 @@ class ClusterBackend:
                                         name="lease-reaper")
         self._reaper.start()
 
+        # telemetry: metric snapshots + task-event spans → head
+        # (reference: metrics agent push + TaskEventBuffer→GcsTaskManager)
+        from ray_tpu.runtime.events import TaskEventBuffer
+        self.event_buffer = TaskEventBuffer()
+        self._telemetry = threading.Thread(target=self._telemetry_loop,
+                                           daemon=True,
+                                           name=f"{role}-telemetry")
+        self._telemetry.start()
+
+    def _telemetry_loop(self) -> None:
+        from ray_tpu.core.config import GlobalConfig
+        from ray_tpu.util import metrics as metrics_mod
+        interval = max(GlobalConfig.metrics_export_period_s, 0.1)
+        me = self.worker.worker_id.hex()
+        while not self._closed:
+            time.sleep(interval)
+            try:
+                snap = metrics_mod.snapshot()
+                events = self.event_buffer.drain()
+                if snap or events:
+                    self.head.oneway("telemetry_push", {
+                        "worker": me, "role": self.role,
+                        "node": self.local_node_id,
+                        "metrics": snap, "events": events})
+            except Exception:  # noqa: BLE001 — telemetry must never kill
+                pass
+
     # ------------------------------------------------------------- factories
 
     @classmethod

@@ -1,0 +1,63 @@
+"""Task event buffer — per-task state transitions for the timeline.
+
+Role-equivalent to the reference's TaskEventBuffer → GcsTaskManager path
+(reference: src/ray/core_worker/task_event_buffer.h batching to
+gcs_task_manager.h:88, surfaced as the dashboard timeline and
+`ray timeline`): workers buffer (task, start, end) spans and the telemetry
+thread flushes them to the head alongside metric snapshots; the CLI
+exports Chrome-trace JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+
+class TaskEventBuffer:
+    MAX_BUFFER = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+
+    def record(self, *, name: str, task_id: str, kind: str,
+               start: float, end: float, ok: bool) -> None:
+        with self._lock:
+            if len(self._events) >= self.MAX_BUFFER:
+                self._dropped += 1
+                return
+            self._events.append({
+                "name": name, "task_id": task_id, "kind": kind,
+                "start": start, "end": end, "ok": ok})
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._events = self._events, []
+            if self._dropped:
+                out.append({"name": "__dropped__", "task_id": "",
+                            "kind": "meta", "start": time.time(),
+                            "end": time.time(), "ok": False,
+                            "dropped": self._dropped})
+                self._dropped = 0
+            return out
+
+
+def to_chrome_trace(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Chrome-trace 'X' (complete) events; load in chrome://tracing or
+    Perfetto (reference: `ray timeline` output format)."""
+    trace = []
+    for e in events:
+        trace.append({
+            "name": e["name"],
+            "cat": e.get("kind", "task"),
+            "ph": "X",
+            "ts": e["start"] * 1e6,
+            "dur": max(e["end"] - e["start"], 0.0) * 1e6,
+            "pid": e.get("node", "node"),
+            "tid": e.get("worker", "worker"),
+            "args": {"task_id": e.get("task_id", ""), "ok": e.get("ok")},
+        })
+    return trace

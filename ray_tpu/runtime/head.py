@@ -19,6 +19,7 @@ transport/normal_task_submitter.h:74) and releases the lease when idle.
 
 from __future__ import annotations
 
+import collections
 import json
 import sys
 import threading
@@ -140,6 +141,11 @@ class Head:
         self._lease_counter = 0
         self._next_job = 0
         self._pgs: Dict[bytes, dict] = {}  # PlacementGroupID bin -> info
+        # telemetry (reference: GcsTaskManager events + metrics agent):
+        # per-worker metric snapshots + bounded task-span ring buffer
+        self._metrics: Dict[str, dict] = {}
+        self._task_events: collections.deque = collections.deque(
+            maxlen=cfg.event_buffer_size)
         self._node_clients = ClientPool(name="head->node")
         self._stopped = threading.Event()
         self.server = RpcServer({
@@ -166,6 +172,9 @@ class Head:
             "cluster_resources": self._h_cluster_resources,
             "available_resources": self._h_available_resources,
             "state_dump": self._h_state_dump,
+            "telemetry_push": self._h_telemetry_push,
+            "metrics_dump": self._h_metrics_dump,
+            "timeline_dump": self._h_timeline_dump,
             "ping": lambda p, c: "pong",
         }, host=host, port=port, max_workers=32, name="head")
         # a crashed client can't release its leases; reclaim them when its
@@ -763,6 +772,31 @@ class Head:
                     for k, v in e.resources.items():
                         total[k] = total.get(k, 0.0) - v
         return total
+
+    def _h_telemetry_push(self, p, ctx):
+        with self._lock:
+            if p.get("metrics"):
+                self._metrics[p["worker"]] = p["metrics"]
+            for e in p.get("events", ()):
+                e["worker"] = p["worker"][:12]
+                e["node"] = p.get("node", "")
+                self._task_events.append(e)
+        return True
+
+    def _h_metrics_dump(self, p, ctx):
+        from ray_tpu.util.metrics import aggregate
+        with self._lock:
+            per_worker = {w: dict(s) for w, s in self._metrics.items()}
+        agg = aggregate(per_worker)
+        # tuple tag keys -> joined strings for wire/json friendliness
+        for m in agg.values():
+            m["values"] = {"|".join(k) if isinstance(k, tuple) else str(k): v
+                           for k, v in m["values"].items()}
+        return agg
+
+    def _h_timeline_dump(self, p, ctx):
+        with self._lock:
+            return list(self._task_events)
 
     def _h_state_dump(self, p, ctx):
         with self._lock:

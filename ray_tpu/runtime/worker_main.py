@@ -20,6 +20,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 
@@ -174,6 +175,8 @@ class Executor:
             return
         num_returns = payload["num_returns"]
         self.worker.current_task_id = TaskID(task_id)
+        t_start = time.time()
+        ok = True
         try:
             args, kwargs = self._resolve_args(payload["args"],
                                               payload["kwargs"])
@@ -192,12 +195,23 @@ class Executor:
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, (SystemExit, KeyboardInterrupt)):
                 raise
+            ok = False
             so = serialization.serialize_error(e)
             ctx.reply({"results": [{"inline": so.to_bytes(),
                                     "is_error": True}] * num_returns})
             return
         finally:
             self.worker.current_task_id = None
+            # task span -> event buffer (flushed by the telemetry thread;
+            # reference: TaskEventBuffer state transitions)
+            buf = getattr(self.backend, "event_buffer", None)
+            if buf is not None:
+                buf.record(
+                    name=payload.get("name") or payload.get(
+                        "method_name") or "task",
+                    task_id=TaskID(task_id).hex()[:16],
+                    kind="actor_task" if payload.get("actor_id") else "task",
+                    start=t_start, end=time.time(), ok=ok)
         # package results
         if num_returns == 1:
             values = [result]

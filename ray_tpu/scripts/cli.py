@@ -146,6 +146,37 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    address = load_address(args.address)
+    agg = _client(address).call("metrics_dump")
+    if args.format == "json":
+        print(json.dumps(agg, indent=2, default=str))
+        return 0
+    for name, m in sorted(agg.items()):
+        if m["type"] == "histogram":
+            for k, v in m["values"].items():
+                mean = v["sum"] / v["n"] if v["n"] else 0.0
+                print(f"{name}{{{k}}}  n={v['n']} mean={mean:.6g}")
+        else:
+            for k, v in m["values"].items():
+                print(f"{name}{{{k}}}  {v:g}")
+    print(f"({len(agg)} metrics)", file=sys.stderr)
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.runtime.events import to_chrome_trace
+    address = load_address(args.address)
+    events = _client(address).call("timeline_dump")
+    trace = to_chrome_trace(events)
+    out = args.out or "ray_tpu_timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out} "
+          "(load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
 def cmd_stop(args) -> int:
     address = load_address(args.address)
     client = _client(address)
@@ -183,6 +214,17 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("metrics", help="aggregated application metrics")
+    sp.add_argument("--address")
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("timeline", help="export task timeline "
+                                         "(chrome trace)")
+    sp.add_argument("--address")
+    sp.add_argument("--out")
+    sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("stop", help="stop node daemons")
     sp.add_argument("--address")

@@ -1,0 +1,184 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Role-equivalent to the reference's metrics API (reference:
+python/ray/util/metrics.py over the C++ OpenCensus registry,
+src/ray/stats/metric.h:103): metrics register in a per-process registry;
+the cluster backend's telemetry thread ships snapshots to the head, which
+aggregates across workers (sum for counters/histograms, last-write for
+gauges) — queryable via the state API / `python -m ray_tpu metrics`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BOUNDS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, "Metric"] = {}
+
+    def register(self, metric: "Metric") -> None:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{type(existing).__name__}")
+                # same metric constructed again (e.g. once per task body):
+                # share the existing state so counts accumulate instead of
+                # resetting with each construction
+                metric._values = existing._values
+                metric._lock = existing._lock
+                if isinstance(metric, Histogram):
+                    metric._counts = existing._counts
+                    metric._sums = existing._sums
+                    metric._ns = existing._ns
+                return
+            self._metrics[metric.name] = metric
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: m._export() for name, m in self._metrics.items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+_registry = _Registry()
+
+
+def snapshot() -> Dict[str, dict]:
+    """This process's current metric values (wire form)."""
+    return _registry.snapshot()
+
+
+def clear_registry() -> None:
+    _registry.clear()
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+        self._default_tags: Dict[str, str] = {}
+        _registry.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def _export(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing count (aggregated by SUM across workers)."""
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _export(self) -> dict:
+        with self._lock:
+            return {"type": "counter", "desc": self.description,
+                    "tag_keys": self.tag_keys,
+                    "values": {k: v for k, v in self._values.items()}}
+
+
+class Gauge(Metric):
+    """Point-in-time value (aggregated by LAST-WRITE per worker)."""
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+    def _export(self) -> dict:
+        with self._lock:
+            return {"type": "gauge", "desc": self.description,
+                    "tag_keys": self.tag_keys,
+                    "values": {k: v for k, v in self._values.items()}}
+
+
+class Histogram(Metric):
+    """Bucketed distribution (per-bucket counts SUM across workers)."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = _DEFAULT_BOUNDS,
+                 tag_keys: Sequence[str] = ()):
+        self.boundaries = tuple(sorted(boundaries))
+        # containers BEFORE register (which may swap in shared state from
+        # an earlier same-name registration — see _Registry.register)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._ns: Dict[Tuple, int] = {}
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._ns[key] = self._ns.get(key, 0) + 1
+
+    def _export(self) -> dict:
+        with self._lock:
+            return {"type": "histogram", "desc": self.description,
+                    "tag_keys": self.tag_keys,
+                    "boundaries": self.boundaries,
+                    "values": {k: {"counts": list(c),
+                                   "sum": self._sums.get(k, 0.0),
+                                   "n": self._ns.get(k, 0)}
+                               for k, c in self._counts.items()}}
+
+
+def aggregate(per_worker: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:
+    """Merge worker snapshots: counters/histograms sum, gauges last-write.
+    (head-side; reference: metrics agent → Prometheus aggregation)."""
+    out: Dict[str, dict] = {}
+    for worker, snap in sorted(per_worker.items()):
+        for name, m in snap.items():
+            cur = out.get(name)
+            if cur is None:
+                import copy
+                out[name] = copy.deepcopy(m)
+                continue
+            if m["type"] == "counter":
+                for k, v in m["values"].items():
+                    cur["values"][k] = cur["values"].get(k, 0.0) + v
+            elif m["type"] == "gauge":
+                cur["values"].update(m["values"])
+            elif m["type"] == "histogram":
+                for k, v in m["values"].items():
+                    tgt = cur["values"].get(k)
+                    if tgt is None:
+                        cur["values"][k] = v
+                    else:
+                        tgt["counts"] = [a + b for a, b in
+                                         zip(tgt["counts"], v["counts"])]
+                        tgt["sum"] += v["sum"]
+                        tgt["n"] += v["n"]
+    return out

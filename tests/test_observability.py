@@ -1,0 +1,97 @@
+"""Metrics + task-timeline tests (reference scope: util/metrics API,
+TaskEventBuffer→GcsTaskManager timeline, `ray timeline` export)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.worker import global_worker
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, aggregate
+
+
+@pytest.fixture(scope="module")
+def cluster_rt():
+    metrics_mod.clear_registry()
+    rt.init(num_cpus=2, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024,
+        "metrics_export_period_s": 0.2,
+    })
+    yield rt
+    rt.shutdown()
+    metrics_mod.clear_registry()
+
+
+def test_metric_types_and_snapshot():
+    metrics_mod.clear_registry()
+    c = Counter("req_total", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("queue_depth")
+    g.set(7)
+    h = Histogram("latency_s", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = metrics_mod.snapshot()
+    assert snap["req_total"]["values"][("/a",)] == 3
+    assert snap["queue_depth"]["values"][()] == 7
+    assert snap["latency_s"]["values"][()]["counts"] == [1, 1, 1]
+    assert snap["latency_s"]["values"][()]["n"] == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    metrics_mod.clear_registry()
+
+
+def test_aggregate_across_workers():
+    w1 = {"c": {"type": "counter", "desc": "", "tag_keys": (),
+                "values": {(): 2.0}}}
+    w2 = {"c": {"type": "counter", "desc": "", "tag_keys": (),
+                "values": {(): 3.0}},
+          "g": {"type": "gauge", "desc": "", "tag_keys": (),
+                "values": {(): 9.0}}}
+    agg = aggregate({"w1": w1, "w2": w2})
+    assert agg["c"]["values"][()] == 5.0
+    assert agg["g"]["values"][()] == 9.0
+
+
+def test_worker_metrics_flow_to_head(cluster_rt):
+    @rt.remote
+    def work(i):
+        from ray_tpu.util.metrics import Counter
+        Counter("tasks_done_test").inc()
+        return i
+
+    assert sorted(rt.get([work.remote(i) for i in range(4)],
+                         timeout=60)) == [0, 1, 2, 3]
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        agg = global_worker.backend.head.call("metrics_dump")
+        got = agg.get("tasks_done_test", {}).get("values", {})
+        if sum(got.values()) >= 4:
+            return
+        time.sleep(0.3)
+    pytest.fail(f"metrics never aggregated at head: {agg}")
+
+
+def test_task_timeline_records_spans(cluster_rt):
+    @rt.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    rt.get([slow.remote() for _ in range(3)], timeout=60)
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        events = global_worker.backend.head.call("timeline_dump")
+        if sum(1 for e in events if e["name"].endswith("slow")) >= 3:
+            break
+        time.sleep(0.3)
+    spans = [e for e in events if e["name"].endswith("slow")]
+    assert len(spans) >= 3, events
+    assert all(e["end"] >= e["start"] + 0.04 for e in spans)
+    from ray_tpu.runtime.events import to_chrome_trace
+    trace = to_chrome_trace(spans)
+    assert all(t["ph"] == "X" and t["dur"] > 0 for t in trace)
