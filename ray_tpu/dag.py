@@ -1,0 +1,108 @@
+"""Lazy task DAGs: fn.bind(...) -> DAGNode -> execute().
+
+Role-equivalent to the reference's Ray DAG layer (reference:
+dag/dag_node.py:32 DAGNode, function_node.py / input_node.py): binding
+builds the graph without executing; execute() walks it bottom-up, submits
+each node ONCE as a task (diamond dependencies deduplicate), and wires
+parent results in as ObjectRefs so the data plane moves values directly
+between workers. The compiled-graph variant (experimental_compile) is the
+reference's aDAG; here the XLA-compiled analog of a static compute graph
+is a jitted program, so only the orchestration DAG is reproduced.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class DAGNode:
+    """One bound task invocation in a lazy graph."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self._fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+    def execute(self, _cache: Optional[Dict[int, Any]] = None):
+        """Submit the whole graph; returns this node's ObjectRef."""
+        cache: Dict[int, Any] = _cache if _cache is not None else {}
+        return self._submit(cache)
+
+    def _submit(self, cache: Dict[int, Any]):
+        if id(self) in cache:
+            return cache[id(self)]
+
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return v._submit(cache)
+            if isinstance(v, InputNode):
+                return v._value()
+            return v
+
+        args = tuple(resolve(a) for a in self._args)
+        kwargs = {k: resolve(v) for k, v in self._kwargs.items()}
+        ref = self._fn.remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+    def __repr__(self):
+        return f"DAGNode({getattr(self._fn, '__name__', 'fn')})"
+
+
+class InputNode:
+    """Placeholder for execute-time input (reference: dag/input_node.py).
+
+    Usage:
+        with InputNode() as inp:
+            dag = f.bind(inp)
+        ray_tpu.dag.execute_with_input(dag, 5)
+    """
+
+    def __init__(self):
+        self._bound_value: Any = _UNSET
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def _value(self):
+        if self._bound_value is _UNSET:
+            raise ValueError("InputNode used but no input supplied — "
+                             "call execute_with_input(value)")
+        return self._bound_value
+
+
+_UNSET = object()
+
+
+def execute_with_input(dag: DAGNode, value: Any):
+    """Execute a DAG that contains InputNode placeholders."""
+    inputs = _find_inputs(dag)
+    for node in inputs:
+        node._bound_value = value
+    try:
+        return dag.execute()
+    finally:
+        for node in inputs:
+            node._bound_value = _UNSET
+
+
+def _find_inputs(node: DAGNode) -> List[InputNode]:
+    out: List[InputNode] = []
+    seen: set = set()
+
+    def walk(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if isinstance(n, InputNode):
+            if n not in out:
+                out.append(n)
+            return
+        if isinstance(n, DAGNode):
+            for v in list(n._args) + list(n._kwargs.values()):
+                walk(v)
+    walk(node)
+    return out

@@ -81,6 +81,12 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ray.dag dag_node.py:32) — builds the
+        graph without executing; see ray_tpu.dag."""
+        from ray_tpu.dag import DAGNode
+        return DAGNode(self, args, kwargs)
+
     @property
     def underlying_function(self):
         return self._function

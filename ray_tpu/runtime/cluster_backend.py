@@ -416,7 +416,7 @@ class ClusterBackend:
         self._submitters: Dict[tuple, _TaskSubmitter] = {}
         self._actor_submitters: Dict[ActorID, _ActorSubmitter] = {}
         self._actor_name_cache: Dict[str, dict] = {}
-        self._fn_keys: Dict[int, str] = {}
+        self._export_epoch = os.urandom(8).hex()  # per-backend cache tag
         self._lock = threading.Lock()
 
         worker.worker_id = worker_id or WorkerID.from_random()
@@ -471,21 +471,24 @@ class ClusterBackend:
 
     def _telemetry_loop(self) -> None:
         from ray_tpu.core.config import GlobalConfig
-        from ray_tpu.util import metrics as metrics_mod
         interval = max(GlobalConfig.metrics_export_period_s, 0.1)
-        me = self.worker.worker_id.hex()
         while not self._closed:
             time.sleep(interval)
-            try:
-                snap = metrics_mod.snapshot()
-                events = self.event_buffer.drain()
-                if snap or events:
-                    self.head.oneway("telemetry_push", {
-                        "worker": me, "role": self.role,
-                        "node": self.local_node_id,
-                        "metrics": snap, "events": events})
-            except Exception:  # noqa: BLE001 — telemetry must never kill
-                pass
+            self._flush_telemetry()
+
+    def _flush_telemetry(self) -> None:
+        from ray_tpu.util import metrics as metrics_mod
+        try:
+            snap = metrics_mod.snapshot()
+            events = self.event_buffer.drain()
+            if snap or events:
+                self.head.oneway("telemetry_push", {
+                    "worker": self.worker.worker_id.hex(),
+                    "role": self.role,
+                    "node": self.local_node_id,
+                    "metrics": snap, "events": events})
+        except Exception:  # noqa: BLE001 — telemetry must never kill
+            pass
 
     # ------------------------------------------------------------- factories
 
@@ -584,12 +587,22 @@ class ClusterBackend:
     # ----------------------------------------------------------------- tasks
 
     def _export_function(self, fn) -> str:
-        key = self._fn_keys.get(id(fn))
-        if key is None:
-            key, blob = wire.export_function(fn)
-            self.head.call_retrying("kv_put", {
-                "key": key, "value": blob, "overwrite": False})
-            self._fn_keys[id(fn)] = key
+        # Cache the export key ON the function object, never keyed by
+        # id(fn): ids are reused after GC, and a stale id->key entry makes
+        # a NEW function silently execute a DEAD function's code on
+        # workers (wrong-function corruption, was a real bug). The cache
+        # carries this backend's epoch so a key cached against a previous
+        # cluster (whose KV died with it) re-exports here.
+        cached = getattr(fn, "__rtpu_export_key__", None)
+        if cached is not None and cached[0] == self._export_epoch:
+            return cached[1]
+        key, blob = wire.export_function(fn)
+        self.head.call_retrying("kv_put", {
+            "key": key, "value": blob, "overwrite": False})
+        try:
+            fn.__rtpu_export_key__ = (self._export_epoch, key)
+        except (AttributeError, TypeError):
+            pass  # unsettable callables just re-export every call
         return key
 
     def submit_task(self, spec: TaskSpec) -> None:
@@ -755,6 +768,7 @@ class ClusterBackend:
         if self._closed:
             return
         self._closed = True
+        self._flush_telemetry()  # last-interval metrics/spans must land
         with self._lock:
             subs = list(self._submitters.values())
         for sub in subs:

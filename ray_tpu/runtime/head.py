@@ -773,10 +773,16 @@ class Head:
                         total[k] = total.get(k, 0.0) - v
         return total
 
+    #: snapshots older than this are from dead/departed workers: drop
+    #: them from aggregation and prune the map (bounds growth under
+    #: worker churn; ~12 missed export periods at the default 5s)
+    METRICS_STALE_S = 60.0
+
     def _h_telemetry_push(self, p, ctx):
         with self._lock:
             if p.get("metrics"):
-                self._metrics[p["worker"]] = p["metrics"]
+                self._metrics[p["worker"]] = {
+                    "ts": time.time(), "snap": p["metrics"]}
             for e in p.get("events", ()):
                 e["worker"] = p["worker"][:12]
                 e["node"] = p.get("node", "")
@@ -785,8 +791,13 @@ class Head:
 
     def _h_metrics_dump(self, p, ctx):
         from ray_tpu.util.metrics import aggregate
+        cutoff = time.time() - self.METRICS_STALE_S
         with self._lock:
-            per_worker = {w: dict(s) for w, s in self._metrics.items()}
+            for w in [w for w, e in self._metrics.items()
+                      if e["ts"] < cutoff]:
+                del self._metrics[w]
+            per_worker = {w: dict(e["snap"])
+                          for w, e in self._metrics.items()}
         agg = aggregate(per_worker)
         # tuple tag keys -> joined strings for wire/json friendliness
         for m in agg.values():

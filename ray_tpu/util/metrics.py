@@ -30,6 +30,17 @@ class _Registry:
                     raise ValueError(
                         f"metric {metric.name!r} already registered as "
                         f"{type(existing).__name__}")
+                if metric.tag_keys != existing.tag_keys:
+                    raise ValueError(
+                        f"metric {metric.name!r} re-registered with "
+                        f"different tag_keys {metric.tag_keys} != "
+                        f"{existing.tag_keys}")
+                if isinstance(metric, Histogram) \
+                        and metric.boundaries != existing.boundaries:
+                    raise ValueError(
+                        f"histogram {metric.name!r} re-registered with "
+                        f"different boundaries (shared bucket counts "
+                        f"would corrupt)")
                 # same metric constructed again (e.g. once per task body):
                 # share the existing state so counts accumulate instead of
                 # resetting with each construction

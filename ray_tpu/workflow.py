@@ -1,0 +1,99 @@
+"""Durable workflows: DAG execution with storage-backed step memoization.
+
+Role-equivalent to the reference's Workflow (reference:
+workflow/workflow_executor.py:32 + workflow_storage.py): each DAG node is
+one step; a step's result is checkpointed to storage the moment it
+completes, keyed by its position in the graph, so re-running the same
+workflow_id after a crash replays only the steps that never finished
+(reference recovery semantics; deterministic steps assumed).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag import DAGNode
+
+_DEFAULT_STORAGE = "/tmp/ray_tpu_workflows"
+
+
+def _step_key(node: DAGNode, path: str) -> str:
+    """Stable step identity: graph position + function name (argument
+    VALUES are not hashed — the graph structure is the identity, matching
+    the reference's step-id-from-DAG-position)."""
+    name = getattr(node._fn, "__qualname__", None) or getattr(
+        getattr(node._fn, "underlying_function", None), "__qualname__",
+        "fn")
+    return hashlib.sha1(f"{path}:{name}".encode()).hexdigest()[:16]
+
+
+class _WorkflowRun:
+    def __init__(self, workflow_id: str, storage: str,
+                 step_timeout_s: float):
+        self.dir = os.path.join(storage, workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.step_timeout_s = step_timeout_s
+        self.executed: Dict[int, Any] = {}
+        self.steps_run = 0
+        self.steps_replayed = 0
+
+    def _ckpt_path(self, key: str) -> str:
+        return os.path.join(self.dir, f"step_{key}.pkl")
+
+    def run_node(self, node: Any, path: str) -> Any:
+        if not isinstance(node, DAGNode):
+            return node
+        if id(node) in self.executed:
+            return self.executed[id(node)]
+        key = _step_key(node, path)
+        ckpt = self._ckpt_path(key)
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                value = cloudpickle.load(f)
+            self.steps_replayed += 1
+            self.executed[id(node)] = value
+            return value
+        args = [self.run_node(a, f"{path}.a{i}")
+                for i, a in enumerate(node._args)]
+        kwargs = {k: self.run_node(v, f"{path}.k{k}")
+                  for k, v in node._kwargs.items()}
+        value = ray_tpu.get(node._fn.remote(*args, **kwargs),
+                            timeout=self.step_timeout_s)
+        tmp = ckpt + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, ckpt)
+        self.steps_run += 1
+        self.executed[id(node)] = value
+        return value
+
+
+def run(dag: DAGNode, *, workflow_id: str,
+        storage: Optional[str] = None,
+        step_timeout_s: float = 24 * 3600.0) -> Any:
+    """Execute (or resume) a workflow; returns the final value.
+
+    Steps run as cluster tasks; each completed step's value persists
+    before the next starts, so a crash loses at most the in-flight step.
+    ``step_timeout_s`` bounds one step (default a day — training-scale).
+    """
+    wf = _WorkflowRun(workflow_id, storage or _DEFAULT_STORAGE,
+                      step_timeout_s)
+    result = wf.run_node(dag, "root")
+    run.last_stats = {"steps_run": wf.steps_run,
+                      "steps_replayed": wf.steps_replayed}
+    return result
+
+
+run.last_stats = {}
+
+
+def delete(workflow_id: str, storage: Optional[str] = None) -> None:
+    import shutil
+    path = os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+    shutil.rmtree(path, ignore_errors=True)

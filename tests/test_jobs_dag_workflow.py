@@ -1,0 +1,160 @@
+"""Job submission, lazy DAGs, durable workflows (reference scope:
+dashboard/modules/job, ray.dag bind/execute, ray.workflow recovery)."""
+
+import os
+import sys
+import time
+import uuid
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, execute_with_input
+from ray_tpu.jobs import FAILED, SUCCEEDED, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster_rt():
+    rt.init(num_cpus=3, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024,
+    })
+    yield rt
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------------- dag
+
+
+def test_dag_bind_execute(cluster_rt):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    @rt.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), add.bind(3, 4))
+    assert rt.get(dag.execute(), timeout=60) == 21
+
+
+def test_dag_diamond_runs_shared_node_once(cluster_rt):
+    marker = f"/tmp/rtpu_dag_{uuid.uuid4().hex[:8]}"
+
+    @rt.remote
+    def base(path):
+        with open(path, "a") as f:
+            f.write("x")
+        return 10
+
+    @rt.remote
+    def inc(v):
+        return v + 1
+
+    @rt.remote
+    def total(a, b):
+        return a + b
+
+    shared = base.bind(marker)
+    dag = total.bind(inc.bind(shared), inc.bind(shared))
+    try:
+        assert rt.get(dag.execute(), timeout=60) == 22
+        assert open(marker).read() == "x", "shared node ran more than once"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_dag_input_node(cluster_rt):
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def add1(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = add1.bind(double.bind(inp))
+    assert rt.get(execute_with_input(dag, 5), timeout=60) == 11
+    assert rt.get(execute_with_input(dag, 7), timeout=60) == 15
+
+
+# ----------------------------------------------------------------- workflow
+
+
+def test_workflow_resumes_from_checkpoints(cluster_rt, tmp_path):
+    side = f"/tmp/rtpu_wf_{uuid.uuid4().hex[:8]}"
+    crash = side + ".crash"
+
+    @rt.remote
+    def step_a():
+        with open(side + ".a", "a") as f:
+            f.write("a")
+        return 5
+
+    @rt.remote
+    def step_b(v):
+        if os.path.exists(crash):
+            os.unlink(crash)
+            raise RuntimeError("boom-first-run")
+        with open(side + ".b", "a") as f:
+            f.write("b")
+        return v * 2
+
+    dag = step_b.bind(step_a.bind())
+    open(crash, "w").close()
+    try:
+        with pytest.raises(Exception, match="boom-first-run"):
+            workflow.run(dag, workflow_id="wf1", storage=str(tmp_path))
+        # resume: step_a must replay from its checkpoint, not re-run
+        out = workflow.run(dag, workflow_id="wf1", storage=str(tmp_path))
+        assert out == 10
+        assert workflow.run.last_stats == {"steps_run": 1,
+                                           "steps_replayed": 1}
+        assert open(side + ".a").read() == "a"
+        assert open(side + ".b").read() == "b"
+        # third run replays everything
+        assert workflow.run(dag, workflow_id="wf1",
+                            storage=str(tmp_path)) == 10
+        assert workflow.run.last_stats["steps_run"] == 0
+    finally:
+        for suffix in (".a", ".b"):
+            if os.path.exists(side + suffix):
+                os.unlink(side + suffix)
+        workflow.delete("wf1", storage=str(tmp_path))
+
+
+# --------------------------------------------------------------------- jobs
+
+
+def test_job_submit_success_and_logs(cluster_rt, tmp_path):
+    script = tmp_path / "job_ok.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "import ray_tpu as rt\n"
+        "rt.init(address=os.environ['RTPU_ADDRESS'])\n"
+        "@rt.remote\n"
+        "def sq(x):\n"
+        "    return x * x\n"
+        "print('RESULT', sum(rt.get([sq.remote(i) for i in range(5)],"
+        " timeout=60)))\n"
+        "rt.shutdown()\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}")
+    assert client.wait(job_id, timeout=240) == SUCCEEDED
+    logs = client.get_job_logs(job_id)
+    assert "RESULT 30" in logs
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id and j["status"] == SUCCEEDED
+               for j in jobs)
+
+
+def test_job_failure_surfaces(cluster_rt):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    assert client.wait(job_id, timeout=120) == FAILED
+    assert "exit code 3" in client.get_job_info(job_id)["message"]
