@@ -136,7 +136,8 @@ class Worker:
             values.append(self._get_one(r, remaining))
         return values[0] if single else values
 
-    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float],
+                 _reconstructed: bool = False) -> Any:
         oid = ref.id()
         if self.backend is not None:
             self.backend.poke_resolve(ref)
@@ -155,7 +156,20 @@ class Worker:
             raise ObjectLostError(oid.hex(), "freed while being fetched")
         value, is_error, in_shm = entry
         if in_shm:
-            value, is_error = self.backend.get_from_store(ref)
+            from ray_tpu.exceptions import ObjectLostError
+            try:
+                value, is_error = self.backend.get_from_store(ref)
+            except ObjectLostError:
+                # lineage reconstruction (reference:
+                # ObjectRecoveryManager): re-execute the creating task
+                # once, then wait for the fresh value
+                if _reconstructed or not getattr(
+                        self.backend, "try_reconstruct",
+                        lambda r: False)(ref):
+                    raise
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                return self._get_one(ref, remaining, _reconstructed=True)
         if is_error:
             raise value
         return value

@@ -417,6 +417,13 @@ class ClusterBackend:
         self._actor_submitters: Dict[ActorID, _ActorSubmitter] = {}
         self._actor_name_cache: Dict[str, dict] = {}
         self._export_epoch = os.urandom(8).hex()  # per-backend cache tag
+        # owner-side lineage: return-object id -> creating TaskSpec, so a
+        # lost shm object can be rebuilt by re-executing its task
+        # (reference: ObjectRecoveryManager, object_recovery_manager.h:38,
+        # lineage pinned in TaskManager bounded by max_lineage_bytes)
+        self._lineage: "collections.OrderedDict[bytes, TaskSpec]" = \
+            collections.OrderedDict()
+        self._lineage_cap = 8192
         self._lock = threading.Lock()
 
         worker.worker_id = worker_id or WorkerID.from_random()
@@ -573,6 +580,10 @@ class ClusterBackend:
         self.object_plane.put_object(object_id, value)
 
     def free_object(self, object_id: ObjectID) -> None:
+        with self._lock:
+            # freed objects must not be reconstructable (and dead
+            # TaskSpecs with inline args are driver-memory ballast)
+            self._lineage.pop(object_id.binary(), None)
         self.object_plane.free_object(object_id)
 
     def try_resolve(self, ref: ObjectRef) -> bool:
@@ -619,7 +630,38 @@ class ClusterBackend:
                 sub = _TaskSubmitter(self, shape_key, dict(spec.resources),
                                      pg=pg)
                 self._submitters[shape_key] = sub
+            # lineage: stateless tasks only (actor calls mutate state and
+            # cannot be replayed — reference restriction)
+            if spec.actor_id is None:
+                for oid in spec.return_ids():
+                    self._lineage[oid.binary()] = spec
+                    self._lineage.move_to_end(oid.binary())
+                while len(self._lineage) > self._lineage_cap:
+                    self._lineage.popitem(last=False)
         sub.submit(payload, spec, pins)
+
+    def try_reconstruct(self, ref: ObjectRef) -> bool:
+        """Rebuild a lost object by re-executing its creating task
+        (reference: ObjectRecoveryManager lineage reconstruction). The
+        respawned task reuses the SAME spec, so results land under the
+        original return object ids."""
+        with self._lock:
+            spec = self._lineage.get(ref.id().binary())
+        if spec is None or spec.actor_id is not None:
+            return False
+        # forget ONLY the lost object's ready marker (deleting healthy
+        # sibling returns would race their concurrent getters into a
+        # spurious ObjectLost); resubmission re-stores every return
+        self.worker.memory_store.delete(ref.id())
+        # re-pin top-level ref args: the reconstruction reply will run the
+        # standard unpin (on_serialized_ref_done) per ref arg, and without
+        # a matching pin here the arg's submitted-count underflows and a
+        # LIVE object gets freed
+        for a in spec.args:
+            if a.is_ref:
+                self.worker.refcounter.on_ref_serialized(a.object_id)
+        self.submit_task(spec)
+        return True
 
     def _pin_args(self, spec: TaskSpec, contained: list) -> list:
         """Collect refs pinned until the task's reply arrives.

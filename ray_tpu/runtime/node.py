@@ -296,14 +296,29 @@ class NodeDaemon:
     # ----------------------------------------------------------- object plane
 
     def _h_read_object(self, p, ctx):
-        """Serve an object's bytes to a remote node (pull path)."""
+        """Serve an object's bytes to a remote node (pull path); falls
+        back to the node's spill directory for disk-overflowed objects."""
         view = self.store.get(p["object_id"])
         if view is None:
-            return None
+            return self._read_spill(p["object_id"])
         try:
             return bytes(view)
         finally:
             self.store.release(p["object_id"])
+
+    def _spill_path(self, oid: bytes) -> str:
+        from ray_tpu.core.config import GlobalConfig
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.runtime.object_plane import spill_file_path
+        return spill_file_path(GlobalConfig.session_dir, self.store.name,
+                               ObjectID(oid).hex())
+
+    def _read_spill(self, oid: bytes):
+        from ray_tpu.core.config import GlobalConfig
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.runtime.object_plane import read_spill_file
+        return read_spill_file(GlobalConfig.session_dir, self.store.name,
+                               ObjectID(oid).hex())
 
     def _h_delete_object(self, p, ctx):
         """Owner-initiated free of a primary copy: drop the creator pin
@@ -311,6 +326,11 @@ class NodeDaemon:
         pins primary copies until the owner frees), then delete. If readers
         still hold pins the store defers deletion to the last release."""
         oid = p["object_id"]
+        try:
+            import os
+            os.unlink(self._spill_path(oid))
+        except OSError:
+            pass
         self.store.release(oid)
         return self.store.delete(oid)
 
@@ -346,6 +366,15 @@ class NodeDaemon:
             pass
         self.server.stop()
         self._clients.close_all()
+        try:
+            import shutil
+            from ray_tpu.core.config import GlobalConfig
+            from ray_tpu.runtime.object_plane import spill_dir_for
+            shutil.rmtree(spill_dir_for(GlobalConfig.session_dir,
+                                        self.store.name),
+                          ignore_errors=True)
+        except Exception:
+            pass
         try:
             self.store.unlink()
         except Exception:

@@ -17,6 +17,7 @@ node-to-node at most once, then are mapped zero-copy by every local reader.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, Optional, Set, Tuple
@@ -28,6 +29,26 @@ from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.exceptions import ObjectLostError
 from ray_tpu.runtime.protocol import ClientPool, RpcError
+
+
+def spill_dir_for(session_dir: str, shm_name: str) -> str:
+    """Shared per-cluster spill directory (same for every process that
+    attaches this shm arena — workers spill, the node daemon serves)."""
+    return os.path.join(session_dir, "spill", shm_name.strip("/"))
+
+
+def spill_file_path(session_dir: str, shm_name: str, oid_hex: str) -> str:
+    return os.path.join(spill_dir_for(session_dir, shm_name), oid_hex)
+
+
+def read_spill_file(session_dir: str, shm_name: str,
+                    oid_hex: str) -> Optional[bytes]:
+    try:
+        with open(spill_file_path(session_dir, shm_name, oid_hex),
+                  "rb") as f:
+            return f.read()
+    except OSError:
+        return None
 
 
 class ObjectPlane:
@@ -104,11 +125,33 @@ class ObjectPlane:
         except ObjectExists:
             return
         except ObjectStoreFull:
-            from ray_tpu.exceptions import ObjectStoreFullError
-            raise ObjectStoreFullError(
-                f"shm store full writing {so.total_bytes} bytes") from None
+            # arena full even after LRU eviction: overflow to disk
+            # (reference: LocalObjectManager::SpillObjects — spilled copies
+            # restore on demand; see spill_path/_h_read_object fallbacks)
+            self._write_spill(object_id, so.to_bytes())
+            return
         so.write_to(memoryview(buf).cast("B"))
         self.store.seal(object_id.binary())
+
+    # ---------------------------------------------------------------- spill
+
+    def _spill_dir(self) -> str:
+        from ray_tpu.core.config import GlobalConfig
+        return spill_dir_for(GlobalConfig.session_dir, self.store.name)
+
+    def _write_spill(self, object_id: ObjectID, data: bytes) -> None:
+        d = self._spill_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, object_id.hex())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def _read_spill(self, object_id: ObjectID) -> Optional[bytes]:
+        from ray_tpu.core.config import GlobalConfig
+        return read_spill_file(GlobalConfig.session_dir, self.store.name,
+                               object_id.hex())
 
     def store_result_bytes(self, object_id: ObjectID, data: bytes,
                            pin: bool = True) -> str:
@@ -127,6 +170,15 @@ class ObjectPlane:
                 self.store.release(object_id.binary())
         except ObjectExists:
             pass
+        except ObjectStoreFull:
+            if pin:
+                # primary copy: overflow to disk; the owner's free path
+                # (delete_object -> node handler) unlinks it
+                self._write_spill(object_id, data)
+            # secondary (cache) copies are NOT spilled: nothing would ever
+            # delete them (owner free only reaches the primary node), so
+            # they'd leak until node shutdown — callers fall back to the
+            # in-memory bytes for the current read instead
         return self.local_node_id
 
     def _register_contained(self, object_id: ObjectID, refs: list) -> None:
@@ -213,7 +265,8 @@ class ObjectPlane:
                     return
                 if "shm" in reply:
                     try:
-                        self._pull_to_local(ref.id(), reply["shm"])
+                        oneshot = self._pull_to_local(ref.id(),
+                                                      reply["shm"])
                     except (RpcError, ObjectLostError) as e:
                         # holder node died mid-pull: surface the loss
                         # instead of killing this thread (a silent death
@@ -223,27 +276,39 @@ class ObjectPlane:
                             ObjectLostError(ref.hex(), f"pull failed: {e}"),
                             is_error=True)
                         return
+                    if oneshot is not None:
+                        # local arena full — hand the value over directly
+                        self.worker.memory_store.put(
+                            ref.id(), serialization.deserialize(oneshot))
+                        return
                     self.worker.memory_store.mark_in_shm(ref.id())
                     return
         finally:
             with self._lock:
                 self._fetching.discard(ref.id())
 
-    def _pull_to_local(self, object_id: ObjectID, node_id: str) -> None:
+    def _pull_to_local(self, object_id: ObjectID,
+                       node_id: str) -> Optional[bytes]:
         """Fetch a sealed object from a remote node into the local arena
         (reference pull path: pull_manager.h:53 -> ObjectManager::Push).
 
         The local copy is a *secondary* (cache) copy: the creator pin is
         released right away so LRU eviction can reclaim it; the primary on
-        `node_id` stays pinned until the owner frees it."""
+        `node_id` stays pinned until the owner frees it. If the local
+        arena is too full to cache, the fetched bytes are RETURNED so the
+        caller can still serve the current read (no disk spill for
+        secondaries — see store_result_bytes)."""
         if node_id == self.local_node_id or \
                 self.store.contains(object_id.binary()):
-            return
+            return None
         data = self.node_client(node_id).call_retrying(
             "read_object", {"object_id": object_id.binary()})
         if data is None:
             raise ObjectLostError(object_id.hex(), f"gone from {node_id}")
         self.store_result_bytes(object_id, data, pin=False)
+        if not self.store.contains(object_id.binary()):
+            return data  # cache miss (arena full): one-shot bytes
+        return None
 
     def get_from_store(self, ref: ObjectRef) -> Tuple[Any, bool]:
         """Blocking read of a sealed object; pulls cross-node if needed.
@@ -260,7 +325,9 @@ class ObjectPlane:
                 if not reply or "shm" not in reply:
                     raise ObjectLostError(oid.hex(), "no longer in shm")
                 node_id = reply["shm"]
-            self._pull_to_local(oid, node_id)
+            oneshot = self._pull_to_local(oid, node_id)
+            if oneshot is not None:
+                return serialization.deserialize(oneshot), False
         # guard=True: each read holds its own pin, released when the last
         # zero-copy view derived from this get dies — NOT when the
         # ObjectRef dies. Freeing the ref must never let the arena reuse
@@ -269,6 +336,9 @@ class ObjectPlane:
         # through an earlier block's array).
         view = self.store.get(oid.binary(), guard=True)
         if view is None:
+            spilled = self._read_spill(oid)
+            if spilled is not None:
+                return serialization.deserialize(spilled), False
             raise ObjectLostError(oid.hex(), "evicted from shm")
         value = serialization.deserialize(view)
         return value, False
