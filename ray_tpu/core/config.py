@@ -45,6 +45,7 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "health_check_period_ms": (int, 1000, "GCS -> node ping period"),
     "health_check_timeout_ms": (int, 5000, "missed-deadline before node marked dead"),
     "task_max_retries_default": (int, 3, "default retries for normal tasks"),
+    "infeasible_grace_s": (float, 30.0, "wait for autoscaling before failing infeasible resource shapes"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
     "max_lineage_bytes": (int, 64 * 1024**2, "lineage cache cap per owner"),
     # --- train / ml ---

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import collections
 import json
+import logging
 import os
 import socket
 import subprocess
@@ -30,6 +31,8 @@ import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.runtime")
 
 from ray_tpu.core import config as config_mod
 from ray_tpu.core import serialization
@@ -81,6 +84,7 @@ class _TaskSubmitter:
         self.pending: collections.deque = collections.deque()
         self.leases: Dict[str, _Lease] = {}
         self.requesting = 0
+        self._infeasible_since: Optional[float] = None
         self.lock = threading.Lock()
 
     # -- public --
@@ -135,7 +139,10 @@ class _TaskSubmitter:
                 with self.lock:
                     if not self.pending:
                         return
-                payload = {"resources": self.resources}
+                with self.lock:
+                    n_pending = len(self.pending)
+                payload = {"resources": self.resources,
+                           "pending": n_pending}
                 if self.pg is not None:
                     payload["pg_id"], payload["bundle_index"] = self.pg
                 try:
@@ -145,11 +152,34 @@ class _TaskSubmitter:
                     time.sleep(0.2)
                     continue
                 if grant.get("infeasible"):
-                    self._fail_pending(TaskError(
-                        "PlacementError",
-                        f"no node can satisfy resources {self.resources}",
-                        "<scheduler>"))
-                    return
+                    # infeasible NOW is the autoscaler's signal to add a
+                    # node (the head recorded the demand): keep waiting
+                    # for a grace period before declaring it impossible
+                    # (reference: infeasible tasks pend + autoscaler
+                    # warning, not immediate failure)
+                    if self._infeasible_since is None:
+                        self._infeasible_since = time.monotonic()
+                        logger.warning(
+                            "no node can currently satisfy resources %s; "
+                            "waiting %.0fs for the cluster to scale",
+                            self.resources,
+                            config_mod.GlobalConfig.infeasible_grace_s)
+                    elif time.monotonic() - self._infeasible_since > \
+                            config_mod.GlobalConfig.infeasible_grace_s:
+                        grace = config_mod.GlobalConfig.infeasible_grace_s
+                        # reset so a LATER submission of this shape gets a
+                        # fresh grace window (the submitter object persists
+                        # per shape)
+                        self._infeasible_since = None
+                        self._fail_pending(TaskError(
+                            "PlacementError",
+                            f"no node can satisfy resources "
+                            f"{self.resources} (waited {grace:.0f}s)",
+                            "<scheduler>"))
+                        return
+                    time.sleep(0.2)
+                    continue
+                self._infeasible_since = None
                 if grant.get("retry"):
                     time.sleep(0.05)
                     continue

@@ -146,6 +146,11 @@ class Head:
         self._metrics: Dict[str, dict] = {}
         self._task_events: collections.deque = collections.deque(
             maxlen=cfg.event_buffer_size)
+        # unserviceable demand, deduped per (requester, shape): each
+        # submitter polls its shape every ~0.2s, so per-poll appends would
+        # over-count 25x per window (the autoscaler's demand signal;
+        # reference: GcsAutoscalerStateManager pending-demand reporting)
+        self._demand: Dict[tuple, dict] = {}
         self._node_clients = ClientPool(name="head->node")
         self._stopped = threading.Event()
         self.server = RpcServer({
@@ -175,6 +180,7 @@ class Head:
             "telemetry_push": self._h_telemetry_push,
             "metrics_dump": self._h_metrics_dump,
             "timeline_dump": self._h_timeline_dump,
+            "autoscaler_state": self._h_autoscaler_state,
             "ping": lambda p, c: "pong",
         }, host=host, port=port, max_workers=32, name="head")
         # a crashed client can't release its leases; reclaim them when its
@@ -278,6 +284,11 @@ class Head:
             resources, policy=p.get("policy", "hybrid"),
             affinity_node=p.get("affinity_node", ""),
             soft=p.get("soft", False))
+        if node_id is not None:
+            with self._lock:  # shape satisfied: retire its demand entry
+                self._demand.pop(
+                    (str(ctx.peer), tuple(sorted(resources.items()))),
+                    None)
         if node_id is None:
             # distinguish busy from impossible: try against total capacity
             with self._lock:
@@ -285,6 +296,10 @@ class Head:
                     all(n.resources.get(k, 0.0) >= v
                         for k, v in resources.items())
                     for n in self._nodes.values() if n.alive)
+                key = (str(ctx.peer), tuple(sorted(resources.items())))
+                self._demand[key] = {
+                    "ts": time.time(), "resources": dict(resources),
+                    "count": max(1, int(p.get("pending", 1)))}
             return {"infeasible": not feasible, "retry": feasible}
         node = self._nodes[node_id]
         try:
@@ -808,6 +823,33 @@ class Head:
     def _h_timeline_dump(self, p, ctx):
         with self._lock:
             return list(self._task_events)
+
+    def _h_autoscaler_state(self, p, ctx):
+        """Demand + per-node busyness for the autoscaler reconciler
+        (reference: gcs_autoscaler_state_manager.h cluster state reply)."""
+        horizon = time.time() - p.get("demand_window_s", 10.0)
+        with self._lock:
+            for k in [k for k, d in self._demand.items()
+                      if d["ts"] < horizon]:
+                del self._demand[k]
+            demand = []
+            for d in self._demand.values():
+                # one shape per pending task, capped (a deep queue should
+                # not request more nodes than it can use at once)
+                demand.extend([dict(d["resources"])] *
+                              min(d["count"], 16))
+            busy_nodes = set()
+            for lease in self._leases.values():
+                busy_nodes.add(lease.node_id)
+            for e in self._actors.values():
+                if e.state in (ALIVE, PENDING, RESTARTING) and e.node_id:
+                    busy_nodes.add(e.node_id)
+            nodes = [{"node_id": n.node_id, "alive": n.alive,
+                      "address": n.address,
+                      "resources": n.resources,
+                      "busy": n.node_id in busy_nodes}
+                     for n in self._nodes.values()]
+        return {"demand": demand, "nodes": nodes}
 
     def _h_state_dump(self, p, ctx):
         with self._lock:

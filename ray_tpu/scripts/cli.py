@@ -177,6 +177,19 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    from ray_tpu.dashboard import Dashboard
+    address = load_address(args.address)
+    dash = Dashboard(address, port=args.port)
+    print(f"dashboard at http://127.0.0.1:{dash.port} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+    return 0
+
+
 def cmd_stop(args) -> int:
     address = load_address(args.address)
     client = _client(address)
@@ -225,6 +238,11 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("--out")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    sp.add_argument("--address")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("stop", help="stop node daemons")
     sp.add_argument("--address")

@@ -1,0 +1,60 @@
+"""Autoscaler tests (reference scope: autoscaler v2 reconciler +
+cluster_utils.AutoscalingCluster over the fake node provider)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.autoscaler import Autoscaler, AutoscalingCluster
+
+
+def test_bin_packing_counts_nodes():
+    a = Autoscaler.__new__(Autoscaler)
+    a.node_type = {"CPU": 2.0}
+    # 3 x 1-CPU shapes fit in 2 nodes; a 4-CPU shape can never fit
+    assert a._nodes_needed([{"CPU": 1.0}] * 3) == 2
+    assert a._nodes_needed([{"CPU": 4.0}]) == 0
+    assert a._nodes_needed([]) == 0
+    assert a._nodes_needed([{"CPU": 2.0}, {"CPU": 2.0}]) == 2
+
+
+def test_scale_up_then_down():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1.0},
+        worker_node_type={"CPU": 2.0},
+        max_workers=2,
+        idle_timeout_s=6.0)
+    try:
+        rt.init(address=cluster.address, _system_config={
+            "infeasible_grace_s": 60.0,
+        })
+
+        @rt.remote(num_cpus=2)
+        def heavy(i):
+            time.sleep(1.0)
+            return i
+
+        # head node has 1 CPU: these shapes are infeasible until the
+        # autoscaler reacts to the recorded demand
+        t0 = time.monotonic()
+        out = rt.get([heavy.remote(i) for i in range(4)], timeout=120)
+        assert sorted(out) == [0, 1, 2, 3]
+        assert len(rt.nodes()) >= 2, "no worker node was launched"
+
+        # drain: nodes idle past the timeout must be terminated
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            alive = [n for n in rt.nodes() if n["Alive"]]
+            if len(alive) == 1:
+                break
+            time.sleep(0.5)
+        alive = [n for n in rt.nodes() if n["Alive"]]
+        assert len(alive) == 1, f"idle nodes never scaled down: {alive}"
+        rt.shutdown()
+    finally:
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()

@@ -1,0 +1,55 @@
+"""Host-side collective group tests (reference scope:
+util/collective tests — allreduce/allgather/reducescatter/broadcast
+across actor processes)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(scope="module")
+def cluster_rt():
+    rt.init(num_cpus=4, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024,
+    })
+    yield rt
+    rt.shutdown()
+
+
+def test_collectives_across_actor_processes(cluster_rt):
+    @rt.remote
+    class Member:
+        def __init__(self, rank, world):
+            from ray_tpu.util.collective import init_collective_group
+            self.g = init_collective_group("g1", world, rank)
+            self.rank = rank
+
+        def run_all(self):
+            import numpy as np
+            out = {}
+            out["allreduce"] = self.g.allreduce(
+                np.full(4, self.rank + 1.0))          # sum over ranks
+            out["mean"] = self.g.allreduce(
+                np.full(2, float(self.rank)), op="mean")
+            out["gather"] = [float(a[0]) for a in self.g.allgather(
+                np.asarray([10.0 * self.rank]))]
+            out["scatter"] = self.g.reducescatter(
+                np.arange(6, dtype=np.float64))       # sum then split
+            out["bcast"] = self.g.broadcast(
+                np.asarray([42.0 + self.rank]), src_rank=1)
+            return out
+
+    world = 3
+    members = [Member.remote(r, world) for r in range(world)]
+    outs = rt.get([m.run_all.remote() for m in members], timeout=120)
+    for rank, out in enumerate(outs):
+        np.testing.assert_allclose(out["allreduce"], np.full(4, 6.0))
+        np.testing.assert_allclose(out["mean"], np.ones(2))
+        assert out["gather"] == [0.0, 10.0, 20.0]
+        np.testing.assert_allclose(out["bcast"], [43.0])
+    # reducescatter: rank r gets its split of sum(3 x arange(6))
+    full = 3 * np.arange(6, dtype=np.float64)
+    splits = np.array_split(full, world)
+    for rank, out in enumerate(outs):
+        np.testing.assert_allclose(out["scatter"], splits[rank])

@@ -1,0 +1,50 @@
+"""Dashboard HTTP surface (reference scope: dashboard head REST +
+state aggregation)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.worker import global_worker
+from ray_tpu.dashboard import Dashboard
+
+
+@pytest.fixture(scope="module")
+def cluster_rt():
+    rt.init(num_cpus=2, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024,
+        "metrics_export_period_s": 0.2,
+    })
+    yield rt
+    rt.shutdown()
+
+
+def test_dashboard_endpoints(cluster_rt):
+    @rt.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.remote()
+    assert rt.get(p.ping.remote(), timeout=60) == "pong"
+
+    dash = Dashboard(global_worker.backend.head_addr)
+    base = f"http://127.0.0.1:{dash.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/api/state", timeout=30) as r:
+            state = json.loads(r.read())
+        assert state["nodes"] and any(a["class"] == "Pinger"
+                                      for a in state["actors"])
+        with urllib.request.urlopen(f"{base}/api/metrics", timeout=30) as r:
+            json.loads(r.read())
+        with urllib.request.urlopen(f"{base}/api/timeline",
+                                    timeout=30) as r:
+            json.loads(r.read())
+        with urllib.request.urlopen(f"{base}/", timeout=30) as r:
+            assert b"ray_tpu" in r.read()
+        with urllib.request.urlopen(f"{base}/api/jobs", timeout=30) as r:
+            assert json.loads(r.read()) == []
+    finally:
+        dash.stop()
