@@ -896,11 +896,17 @@ def _wait_ready(address: str, proc: subprocess.Popen, what: str,
     raise RuntimeError(f"{what} not ready after {timeout}s")
 
 
-def start_head(session: str, port: Optional[int] = None
+def start_head(session: str, port: Optional[int] = None,
+               persist_path: Optional[str] = None
                ) -> Tuple[subprocess.Popen, str]:
+    """persist_path enables KV durability: a restarted head pointed at
+    the same file serves the previous KV table (reference role: GCS
+    Redis persistence, scoped to the KV/jobs tables)."""
     port = port or _free_port()
     cmd = [sys.executable, "-m", "ray_tpu.runtime.head", str(port), session,
            config_mod.GlobalConfig.to_json()]
+    if persist_path:
+        cmd.append(persist_path)
     proc = subprocess.Popen(cmd, env=_child_env())
     address = f"127.0.0.1:{port}"
     _wait_ready(address, proc, "head")

@@ -126,8 +126,17 @@ class _LeaseEntry:
 
 class Head:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 session: str = ""):
+                 session: str = "", persist_path: str = ""):
         self.session = session
+        # KV durability (reference: GCS table persistence via Redis,
+        # store_client/redis_store_client.h — scoped here to the KV table
+        # + job records: actors/leases are process state and die with
+        # their processes; a restarted head serves KV-backed data again)
+        self._persist_path = persist_path
+        self._persist_dirty = False
+        # serializes snapshot WRITES (persist loop vs stop(): two threads
+        # sharing one .tmp path would interleave into a torn pickle)
+        self._persist_write_lock = threading.Lock()
         self.cluster = ClusterState()
         cfg = config_mod.GlobalConfig
         self.cluster.set_spread_threshold(cfg.scheduler_spread_threshold)
@@ -188,9 +197,62 @@ class Head:
         # the owner dies — lease lifetime is bound to the owner)
         self.server.on_disconnect = self._on_client_disconnect
         self.address = self.server.address
+        if self._persist_path:
+            self._load_kv()
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True, name="head-persist")
+            self._persist_thread.start()
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="head-health")
         self._health_thread.start()
+
+    # -------------------------------------------------------- KV durability
+
+    def _load_kv(self) -> None:
+        import os
+        import pickle
+        if not os.path.exists(self._persist_path):
+            return  # fresh cluster: nothing to restore
+        try:
+            with open(self._persist_path, "rb") as f:
+                data = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — unreadable/torn snapshot
+            print(f"WARNING: discarding unreadable KV snapshot "
+                  f"{self._persist_path}: {e!r}", file=sys.stderr,
+                  flush=True)
+            return
+        with self._lock:
+            self._kv.update(data.get("kv", {}))
+
+    def _save_kv(self) -> None:
+        import os
+        import pickle
+        with self._persist_write_lock:
+            with self._lock:
+                if not self._persist_dirty:
+                    return
+                snap = {"kv": dict(self._kv)}
+                self._persist_dirty = False
+            try:
+                tmp = self._persist_path + ".tmp"
+                os.makedirs(os.path.dirname(self._persist_path) or ".",
+                            exist_ok=True)
+                with open(tmp, "wb") as f:
+                    pickle.dump(snap, f)
+                os.replace(tmp, self._persist_path)
+            except Exception:
+                # failed write must not discard the dirty state: re-mark
+                # so the loop retries once the disk recovers
+                with self._lock:
+                    self._persist_dirty = True
+                raise
+
+    def _persist_loop(self) -> None:
+        while not self._stopped.wait(1.0):
+            try:
+                self._save_kv()
+            except Exception:  # noqa: BLE001
+                pass
 
     # ------------------------------------------------------------- membership
 
@@ -228,6 +290,7 @@ class Head:
             exists = p["key"] in self._kv
             if p.get("overwrite", True) or not exists:
                 self._kv[p["key"]] = p["value"]
+                self._persist_dirty = True
         return not exists
 
     def _h_kv_get(self, p, ctx):
@@ -236,7 +299,10 @@ class Head:
 
     def _h_kv_del(self, p, ctx):
         with self._lock:
-            return self._kv.pop(p["key"], None) is not None
+            hit = self._kv.pop(p["key"], None) is not None
+            if hit:
+                self._persist_dirty = True
+            return hit
 
     def _h_kv_keys(self, p, ctx):
         prefix = p.get("prefix", "")
@@ -871,6 +937,11 @@ class Head:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self._persist_path:
+            try:
+                self._save_kv()
+            except Exception:  # noqa: BLE001
+                pass
         self.server.stop()
         self._node_clients.close_all()
 
@@ -883,7 +954,8 @@ def main() -> None:
     session = sys.argv[2]
     if len(sys.argv) > 3:
         config_mod.GlobalConfig.apply(json.loads(sys.argv[3]))
-    head = Head(port=port, session=session)
+    persist = sys.argv[4] if len(sys.argv) > 4 else ""
+    head = Head(port=port, session=session, persist_path=persist)
     stop = threading.Event()
 
     def _term(*_):

@@ -1,0 +1,41 @@
+"""Head KV durability (reference role: GCS persistence via Redis,
+store_client/redis_store_client.h — scoped to the KV/jobs tables: a
+restarted head serves the previous KV; actors/leases are process state
+and do not survive)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+from ray_tpu.runtime.cluster_backend import start_head
+from ray_tpu.runtime.protocol import RpcClient
+
+
+def test_kv_survives_head_restart(tmp_path):
+    persist = str(tmp_path / "gcs_state.pkl")
+    proc, addr = start_head("persistA", persist_path=persist)
+    try:
+        c = RpcClient(addr, name="t")
+        c.call("kv_put", {"key": "job:j1:status", "value": b"SUCCEEDED"})
+        c.call("kv_put", {"key": "cfg", "value": b"v1"})
+        c.call("kv_del", {"key": "cfg"})
+        # force a flush: the persist loop runs every 1s
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not os.path.exists(persist):
+            time.sleep(0.2)
+        c.close()
+    finally:
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.wait(timeout=10)
+
+    proc2, addr2 = start_head("persistB", persist_path=persist)
+    try:
+        c2 = RpcClient(addr2, name="t2")
+        assert c2.call("kv_get", {"key": "job:j1:status"}) == b"SUCCEEDED"
+        assert c2.call("kv_get", {"key": "cfg"}) is None
+        c2.close()
+    finally:
+        os.kill(proc2.pid, signal.SIGTERM)
+        proc2.wait(timeout=10)
