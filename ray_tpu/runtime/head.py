@@ -146,6 +146,11 @@ class Head:
         self._named: Dict[str, bytes] = {}  # "ns:name" -> actor_id
         self._actor_by_worker: Dict[bytes, bytes] = {}  # worker_id -> actor_id
         self._kv: Dict[str, bytes] = {}
+        if self._persist_path:
+            # restore BEFORE the RPC server exists: a client whose ping
+            # succeeded must never read a miss on persisted keys or have
+            # a fresh put clobbered by the stale snapshot applying late
+            self._load_kv()
         self._leases: Dict[str, _LeaseEntry] = {}
         self._lease_counter = 0
         self._next_job = 0
@@ -198,7 +203,6 @@ class Head:
         self.server.on_disconnect = self._on_client_disconnect
         self.address = self.server.address
         if self._persist_path:
-            self._load_kv()
             self._persist_thread = threading.Thread(
                 target=self._persist_loop, daemon=True, name="head-persist")
             self._persist_thread.start()

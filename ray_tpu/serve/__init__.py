@@ -21,10 +21,12 @@ from ray_tpu.serve.controller import (CONTROLLER_NAME, SERVE_NAMESPACE,
                                       ServeController)
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
+from ray_tpu.serve.batching import batch
+
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "delete", "Deployment", "Application", "DeploymentHandle",
-    "DeploymentResponse", "start_http_proxy",
+    "DeploymentResponse", "start_http_proxy", "batch",
 ]
 
 

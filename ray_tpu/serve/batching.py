@@ -1,0 +1,149 @@
+"""@serve.batch — coalesce concurrent calls into one batched invocation.
+
+Role-equivalent to the reference's serve batching (reference:
+serve/batching.py @serve.batch): concurrent requests enqueue and block; a
+dedicated batcher thread per (function, instance) collects up to
+``max_batch_size`` inputs (waiting at most ``batch_wait_timeout_s`` after
+the first), runs the underlying function ONCE on the list, and fans the
+results back out. On TPU this is the difference between B matmul
+dispatches and one batched program — the core serving efficiency lever.
+
+The batcher is its own daemon thread (the reference uses an asyncio task),
+so no request lane is ever parked leading a batch and the caller that
+triggered a batch gets its reply as soon as that batch finishes.
+
+    class Model:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.01)
+        def predict(self, inputs: list):   # list in -> list out
+            return model(np.stack(inputs)).tolist()
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, owner: Any, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.owner = owner
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.items: List[dict] = []
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serve-batch-{getattr(fn, '__name__', 'fn')}")
+        self._thread.start()
+
+    def submit(self, value: Any) -> Any:
+        entry = {"value": value, "done": threading.Event(),
+                 "result": None, "error": None}
+        with self.lock:
+            self.items.append(entry)
+            self.cv.notify_all()
+        entry["done"].wait()
+        if entry["error"] is not None:
+            # a COPY per waiter: re-raising one shared instance from N
+            # threads concurrently rewrites its __traceback__ under them
+            raise copy.copy(entry["error"])
+        return entry["result"]
+
+    def _loop(self) -> None:
+        while True:
+            with self.lock:
+                while not self.items:
+                    self.cv.wait()
+                deadline = time.monotonic() + self.timeout
+                while len(self.items) < self.max_batch_size:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self.cv.wait(timeout=remaining)
+                batch = self.items[:self.max_batch_size]
+                self.items = self.items[self.max_batch_size:]
+            self._run(batch)
+
+    def _run(self, batch: List[dict]) -> None:
+        try:
+            inputs = [e["value"] for e in batch]
+            results = self.fn(self.owner, inputs) \
+                if self.owner is not None else self.fn(inputs)
+            if not isinstance(results, (list, tuple)) \
+                    or len(results) != len(batch):
+                raise TypeError(
+                    f"@serve.batch function must return a list of "
+                    f"len(batch)={len(batch)}, got {type(results)}")
+            for e, r in zip(batch, results):
+                e["result"] = r
+        except BaseException as exc:  # noqa: BLE001 — fan the error out
+            for e in batch:
+                e["error"] = exc
+        finally:
+            for e in batch:
+                e["done"].set()
+
+
+_CREATE_LOCK = threading.Lock()
+#: plain-function queues by qualname (functions don't churn; instances
+#: store their queue as an attribute so it dies with the instance —
+#: a global id(owner)-keyed registry would leak AND could hand a new
+#: instance a dead one's queue after id reuse)
+_FUNC_QUEUES: dict = {}
+
+
+def _method_queue(fn: Callable, owner: Any, max_batch_size: int,
+                  timeout_s: float) -> _BatchQueue:
+    attr = f"__rtpu_batchq_{getattr(fn, '__name__', 'fn')}"
+    q = getattr(owner, attr, None)
+    if q is None:
+        with _CREATE_LOCK:
+            q = getattr(owner, attr, None)
+            if q is None:
+                q = _BatchQueue(fn, owner, max_batch_size, timeout_s)
+                setattr(owner, attr, q)
+    return q
+
+
+def _func_queue(fn: Callable, max_batch_size: int,
+                timeout_s: float) -> _BatchQueue:
+    key = getattr(fn, "__qualname__", repr(fn))
+    with _CREATE_LOCK:
+        q = _FUNC_QUEUES.get(key)
+        if q is None:
+            q = _BatchQueue(fn, None, max_batch_size, timeout_s)
+            _FUNC_QUEUES[key] = q
+        return q
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator; the wrapped function receives a LIST of inputs and must
+    return a list of equal length (reference @serve.batch contract)."""
+
+    def wrap(fn: Callable):
+        import functools
+        import inspect
+
+        @functools.wraps(fn)
+        def method(self, value):
+            return _method_queue(fn, self, max_batch_size,
+                                 batch_wait_timeout_s).submit(value)
+
+        @functools.wraps(fn)
+        def func(value):
+            return _func_queue(fn, max_batch_size,
+                               batch_wait_timeout_s).submit(value)
+
+        params = list(inspect.signature(fn).parameters)
+        is_method = params and params[0] == "self"
+        return method if is_method else func
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

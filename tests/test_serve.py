@@ -210,6 +210,32 @@ def test_user_config_reconfigure_in_place(serve_rt):
     pytest.fail("reconfigure never applied")
 
 
+def test_serve_batch_coalesces_requests(serve_rt):
+    @serve.deployment(name="batched", max_ongoing_requests=8)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def infer(self, inputs):
+            self.batch_sizes.append(len(inputs))
+            return [x * 10 for x in inputs]
+
+        def __call__(self, x):
+            return self.infer(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batched.bind())
+    resps = [h.remote(i) for i in range(8)]
+    out = sorted(r.result(timeout=60) for r in resps)
+    assert out == [i * 10 for i in range(8)]
+    sizes = h.sizes.remote().result(timeout=30)
+    assert max(sizes) > 1, f"no coalescing happened: {sizes}"
+    assert sum(sizes) == 8
+
+
 def test_delete_deployment(serve_rt):
     @serve.deployment(name="gone")
     def f(_):
