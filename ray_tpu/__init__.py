@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.worker import global_worker, require_connected
 from ray_tpu.remote_function import remote_decorator as remote
 from ray_tpu.actor import ActorHandle, get_actor
@@ -24,7 +25,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "ObjectRef", "ActorHandle",
+    "kill", "cancel", "get_actor", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle",
     "cluster_resources", "available_resources", "nodes", "exceptions",
     "get_runtime_context", "method", "__version__",
 ]

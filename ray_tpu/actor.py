@@ -85,7 +85,9 @@ class ActorClass:
             resources=_build_resources(opts),
             max_restarts=int(opts.get("max_restarts", 0)),
             max_task_retries=int(opts.get("max_task_retries", 0)),
-            max_concurrency=int(opts.get("max_concurrency", 1)),
+            max_concurrency=(int(opts["max_concurrency"])
+                             if opts.get("max_concurrency") is not None
+                             else None),
             concurrency_groups=dict(opts.get("concurrency_groups") or {}),
             method_groups={
                 m: o["concurrency_group"]
@@ -120,18 +122,22 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         worker = require_connected()
         seq = self._handle._next_seq()
+        streaming = self._num_returns == "streaming"
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self._handle._actor_id),
             name=f"{self._handle._class_name}.{self._method_name}",
             args=worker.make_task_args(args),
             kwargs=dict(kwargs),
-            num_returns=self._num_returns,
+            num_returns=0 if streaming else self._num_returns,
+            streaming=streaming,
             actor_id=self._handle._actor_id,
             method_name=self._method_name,
             seq_no=seq,
             max_retries=self._handle._max_task_retries,
         )
         refs = worker.submit_actor_task(spec)
+        if streaming:
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

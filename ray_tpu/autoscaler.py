@@ -10,8 +10,9 @@ and terminates nodes idle beyond the timeout.
 
 ``LocalNodeProvider`` launches node daemons as local subprocesses — the
 reference's fake_multi_node provider trick (SURVEY §4 item 3) promoted to
-the first-class test/dev provider. A cloud TPU-VM provider implements the
-same three methods against the GCE API.
+the first-class test/dev provider. A cloud TPU-VM provider would plug in
+here by implementing the same two NodeProvider methods against the GCE
+API (none ships in-tree: this image has no cloud access to test one).
 """
 
 from __future__ import annotations
@@ -27,7 +28,14 @@ logger = logging.getLogger("ray_tpu.autoscaler")
 
 
 class NodeProvider:
-    """Launch/terminate nodes (reference: autoscaler/node_provider.py)."""
+    """Launch/terminate nodes (reference: autoscaler/node_provider.py).
+
+    Contract: ``create_node`` must stamp the returned handle with an
+    ``rtpu_node_id`` attribute — the node id the launched daemon will
+    register under. The autoscaler adopts registrations by that identity,
+    so a manual join racing an in-flight launch is never mistaken for an
+    autoscaler-owned node (and never idle-terminated).
+    """
 
     def create_node(self, resources: Dict[str, float]) -> Any:
         raise NotImplementedError
@@ -44,9 +52,13 @@ class LocalNodeProvider(NodeProvider):
         self.session = session
 
     def create_node(self, resources: Dict[str, float]):
+        from ray_tpu.core.ids import NodeID
         from ray_tpu.runtime.cluster_backend import start_node
-        return start_node(self.head_addr, self.session,
-                          resources=dict(resources))
+        node_id = NodeID.from_random().hex()
+        proc = start_node(self.head_addr, self.session,
+                          resources=dict(resources), node_id=node_id)
+        proc.rtpu_node_id = node_id
+        return proc
 
     def terminate_node(self, handle) -> None:
         try:
@@ -138,26 +150,24 @@ class Autoscaler:
 
     def _adopt_registered(self, nodes: List[dict]) -> None:
         """Move pending launches into the launched map once their node
-        registers with the head (matched by process liveness: a pending
-        subprocess that died without registering is dropped)."""
+        registers with the head, matched by the launch identity the
+        provider stamped on the handle (``rtpu_node_id``) — never by
+        arrival order, so a foreign node registering mid-launch cannot be
+        adopted and later idle-terminated (advisor r2)."""
         known = {n["node_id"] for n in nodes}
-        if not self._pending:
-            # anything registered while we had no launches in flight is
-            # someone else's node (the static head node, manual joins) —
-            # never adopt or terminate those
-            self._foreign |= known - set(self._launched)
-            return
-        new_ids = known - set(self._launched) - self._foreign - {None}
         still = []
         for handle in self._pending:
-            if getattr(handle, "poll", lambda: None)() is not None:
+            nid = getattr(handle, "rtpu_node_id", None)
+            if nid is not None and nid in known:
+                self._launched[nid] = handle
+            elif getattr(handle, "poll", lambda: None)() is not None:
                 logger.warning("autoscaler: launched node died pre-register")
-                continue
-            if new_ids:
-                self._launched[new_ids.pop()] = handle
             else:
                 still.append(handle)
         self._pending = still
+        # everything not ours is someone else's node (the static head
+        # node, manual joins) — never adopt or terminate those
+        self._foreign |= known - set(self._launched)
 
     def _nodes_needed(self, demand: List[Dict[str, float]]) -> int:
         """Bin-pack pending shapes onto copies of node_type (reference:

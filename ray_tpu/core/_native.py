@@ -99,6 +99,10 @@ def _release_pin(store: "ShmStore", key: bytes) -> None:
     drained the guard table (releasing the pins itself), or at interpreter
     shutdown, this is a no-op."""
     try:
+        # decrement AND release under one lock: close() closes/nulls _h
+        # under the same lock, so the handle can't be freed between the
+        # check and the ctypes call (advisor r2: null/dangling handle
+        # passed to rtpu_store_release during the shutdown window)
         with store._guard_lock:
             n = store._guarded.get(key, 0)
             if n <= 0:
@@ -107,8 +111,8 @@ def _release_pin(store: "ShmStore", key: bytes) -> None:
                 store._guarded.pop(key)
             else:
                 store._guarded[key] = n - 1
-        if store._h:
-            store._lib.rtpu_store_release(store._h, key)
+            if store._h:
+                store._lib.rtpu_store_release(store._h, key)
     except Exception:  # noqa: BLE001 — finalizers must never raise
         pass
 
@@ -222,13 +226,16 @@ class ShmStore:
         return {f[0]: getattr(st, f[0]) for f in _StoreStats._fields_}
 
     def close(self) -> None:
-        if self._h:
-            # Drain outstanding guarded pins first: live views become
-            # dangling (the caller is shutting down), but the shared arena
-            # must see the pin_counts drop or delete_pending objects leak
-            # until the node restarts.
-            with self._guard_lock:
-                drained, self._guarded = dict(self._guarded), {}
+        # Drain outstanding guarded pins first: live views become
+        # dangling (the caller is shutting down), but the shared arena
+        # must see the pin_counts drop or delete_pending objects leak
+        # until the node restarts. Drain + close + null all happen under
+        # _guard_lock so a concurrent finalizer (which releases under the
+        # same lock) can never use the handle after it is freed.
+        with self._guard_lock:
+            if not self._h:
+                return
+            drained, self._guarded = dict(self._guarded), {}
             for key, n in drained.items():
                 for _ in range(n):
                     try:

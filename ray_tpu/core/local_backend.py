@@ -31,13 +31,23 @@ class _LocalActor:
         self.dead = False
         self.death_reason = ""
         self.restarts_left = spec.max_restarts
+        self._aio_loop = None  # created at construct for async actors
         self.thread = threading.Thread(target=self._run, daemon=True,
                                        name=f"actor-{spec.name}")
         self.thread.start()
 
     def _construct(self) -> None:
+        import asyncio
+        import inspect
         args = self.backend._resolve_args(self.spec.args)
         self.instance = self.spec.cls(*args, **self.spec.kwargs)
+        cls = type(self.instance)
+        if any(inspect.iscoroutinefunction(getattr(cls, n, None))
+               or inspect.isasyncgenfunction(getattr(cls, n, None))
+               for n in dir(cls)):
+            self._aio_loop = asyncio.new_event_loop()
+            threading.Thread(target=self._aio_loop.run_forever, daemon=True,
+                             name=f"actor-aio-{self.spec.name}").start()
 
     def _run(self) -> None:
         try:
@@ -63,6 +73,16 @@ class _LocalActor:
                     spec, AttributeError(f"no method {spec.method_name}"))
                 continue
             try:
+                if self._aio_loop is not None:
+                    # async actor: schedule on the loop, don't block the
+                    # queue — concurrent calls interleave like the
+                    # cluster-mode asyncio path
+                    self._submit_async(method, args, spec)
+                    continue
+                if spec.streaming:
+                    self.backend._drain_stream(spec, method(*args,
+                                                            **spec.kwargs))
+                    continue
                 result = method(*args, **spec.kwargs)
                 self.backend._store_result(spec, result)
             except BaseException as e:  # noqa: BLE001
@@ -74,6 +94,54 @@ class _LocalActor:
                     self._drain_with_error()
                     return
                 self.backend._store_error(spec, e)
+
+    def _submit_async(self, method, args, spec: TaskSpec) -> None:
+        import asyncio
+        import inspect
+
+        async def run():
+            if inspect.isasyncgenfunction(method):
+                if not spec.streaming:
+                    raise TypeError(
+                        f"{spec.method_name} is an async generator — call "
+                        f"it with num_returns='streaming'")
+                agen = method(*args, **spec.kwargs)
+                i = 0
+                try:
+                    async for v in agen:
+                        i += 1
+                        self.backend._store_stream_item(spec, i, v)
+                except BaseException as e:  # noqa: BLE001
+                    self.backend._finish_stream(spec, i, e)
+                    return None, True
+                finally:
+                    # release ObjectRef args like every other completion path
+                    for a in spec.args:
+                        if a.is_ref:
+                            self.backend.worker.refcounter \
+                                .on_serialized_ref_done(a.object_id)
+                self.backend._finish_stream(spec, i, None)
+                return None, True
+            out = method(*args, **spec.kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if spec.streaming:
+                self.backend._drain_stream(spec, out)
+                return None, True
+            return out, False
+
+        fut = asyncio.run_coroutine_threadsafe(run(), self._aio_loop)
+
+        def done(f):
+            try:
+                result, handled = f.result()
+            except BaseException as e:  # noqa: BLE001
+                self.backend._store_error(spec, e)
+                return
+            if not handled:
+                self.backend._store_result(spec, result)
+
+        fut.add_done_callback(done)
 
     def _drain_with_error(self) -> None:
         while True:
@@ -160,11 +228,67 @@ class LocalBackend:
     def _store_error(self, spec: TaskSpec, exc: BaseException) -> None:
         if not isinstance(exc, (TaskError, ActorDiedError, TaskCancelledError)):
             exc = TaskError.from_exception(exc)
+        if spec.streaming:
+            self._finish_stream(spec, None, exc)
         for rid in spec.return_ids():
             self.worker.memory_store.put(rid, exc, is_error=True)
         for a in spec.args:
             if a.is_ref:
                 self.worker.refcounter.on_serialized_ref_done(a.object_id)
+
+    # ------------------------------------------------------------ streaming
+    # Same owner-side contract as the cluster backend: items land in the
+    # memory store under for_return ids as they are produced; the
+    # StreamState records completion/error (see core/generator.py).
+
+    def register_stream(self, spec: TaskSpec):
+        from ray_tpu.core.generator import ObjectRefGenerator, StreamState
+        state = StreamState()
+        with self._lock:
+            if not hasattr(self, "_streams"):
+                self._streams: Dict[bytes, Any] = {}
+            self._streams[spec.task_id.binary()] = state
+        return ObjectRefGenerator(spec.task_id, self.worker.worker_id,
+                                  self.worker, state)
+
+    def _stream_state(self, spec: TaskSpec):
+        with self._lock:
+            return getattr(self, "_streams", {}).get(spec.task_id.binary())
+
+    def _finish_stream(self, spec: TaskSpec, total, error) -> None:
+        """Complete + drop the stream state (popping mirrors the cluster
+        backend's _finish_stream — a long-lived driver must not accumulate
+        one StreamState per streaming call)."""
+        with self._lock:
+            state = getattr(self, "_streams", {}).pop(
+                spec.task_id.binary(), None)
+        if state is not None:
+            if error is not None and not isinstance(
+                    error, (TaskError, ActorDiedError, TaskCancelledError)):
+                error = TaskError.from_exception(error)
+            state.finish(total, error)
+
+    def _store_stream_item(self, spec: TaskSpec, index: int, value) -> None:
+        oid = ObjectID.for_return(spec.task_id, index)
+        self.worker.refcounter.mark_owned(oid)
+        self.worker.memory_store.put(oid, value)
+
+    def _drain_stream(self, spec: TaskSpec, result) -> None:
+        i = 0
+        try:
+            for v in iter(result):
+                i += 1
+                self._store_stream_item(spec, i, v)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, (SystemExit, KeyboardInterrupt)):
+                raise
+            self._finish_stream(spec, i, e)
+            return
+        finally:
+            for a in spec.args:
+                if a.is_ref:
+                    self.worker.refcounter.on_serialized_ref_done(a.object_id)
+        self._finish_stream(spec, i, None)
 
     def submit_task(self, spec: TaskSpec) -> None:
         def _run(attempt: int = 0):
@@ -174,6 +298,9 @@ class LocalBackend:
             try:
                 args = self._resolve_args(spec.args)
                 result = spec.function(*args, **spec.kwargs)
+                if spec.streaming:
+                    self._drain_stream(spec, result)
+                    return
                 self._store_result(spec, result)
             except BaseException as e:  # noqa: BLE001
                 # In local mode every failure is an application error, so the

@@ -69,32 +69,47 @@ class SerializedObject:
         return bytes(out)
 
 
+class _RefTrackingPickler(cloudpickle.CloudPickler):
+    """CloudPickler that records nested ObjectRefs into self.contained."""
+
+    contained: list
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        from ray_tpu.core.object_ref import ObjectRef
+        if isinstance(obj, ObjectRef):
+            self.contained.append(obj)
+            return NotImplemented
+        # Delegate to CloudPickler: its reducer_override is where
+        # by-value pickling of local functions/lambdas/classes lives —
+        # returning NotImplemented here would silently downgrade to
+        # by-reference pickling, which breaks closures in task args.
+        return super().reducer_override(obj)
+
+
+#: exact types that plain-pickle cheaply and can never contain an ObjectRef
+#: or an out-of-band buffer — the hot microbenchmark path (empty kwargs,
+#: scalar args, tiny byte results) skips the CloudPickler entirely
+_TRIVIAL_TYPES = frozenset(
+    (type(None), bool, int, float, str, bytes, bytearray))
+
+
 def serialize(value: Any) -> SerializedObject:
-    buffers: List[pickle.PickleBuffer] = []
-    contained_refs: list = []
-
-    from ray_tpu.core.object_ref import ObjectRef
-
-    class _Pickler(cloudpickle.CloudPickler):
-        def persistent_id(self, obj):
-            return None
-
-        def reducer_override(self, obj):
-            if isinstance(obj, ObjectRef):
-                contained_refs.append(obj)
-                return NotImplemented
-            # Delegate to CloudPickler: its reducer_override is where
-            # by-value pickling of local functions/lambdas/classes lives —
-            # returning NotImplemented here would silently downgrade to
-            # by-reference pickling, which breaks closures in task args.
-            return super().reducer_override(obj)
+    t = type(value)
+    if t in _TRIVIAL_TYPES or ((t is dict or t is tuple or t is list)
+                               and not value):
+        return SerializedObject(pickle.dumps(value, protocol=5), [], [])
 
     import io
+    buffers: List[pickle.PickleBuffer] = []
     out = io.BytesIO()
-    p = _Pickler(out, protocol=5, buffer_callback=buffers.append)
+    p = _RefTrackingPickler(out, protocol=5, buffer_callback=buffers.append)
+    p.contained = []
     # jax.Array: move to host numpy before pickling so buffers are host memory.
     p.dump(_prepare(value))
-    return SerializedObject(out.getvalue(), buffers, contained_refs)
+    return SerializedObject(out.getvalue(), buffers, p.contained)
 
 
 def _prepare(value: Any) -> Any:

@@ -35,6 +35,9 @@ class TaskSpec:
     args: List[TaskArg] = field(default_factory=list)
     kwargs: Dict[str, Any] = field(default_factory=dict)
     num_returns: int = 1
+    #: num_returns="streaming": yielded values become refs incrementally
+    #: (reference: _raylet.pyx streaming generator protocol)
+    streaming: bool = False
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0
     retry_exceptions: bool = False
@@ -53,8 +56,14 @@ class TaskSpec:
         return self.actor_id is not None and self.method_name != "__init__"
 
     def return_ids(self) -> List[ObjectID]:
-        return [ObjectID.for_return(self.task_id, i + 1)
-                for i in range(self.num_returns)]
+        # cached: callers hit this several times per task on the submit
+        # hot path (lineage, ref registration, reply store)
+        rids = getattr(self, "_rids", None)
+        if rids is None:
+            rids = [ObjectID.for_return(self.task_id, i + 1)
+                    for i in range(self.num_returns)]
+            object.__setattr__(self, "_rids", rids)
+        return rids
 
 
 @dataclass
@@ -70,7 +79,9 @@ class ActorCreationSpec:
     resources: Dict[str, float] = field(default_factory=dict)
     max_restarts: int = 0
     max_task_retries: int = 0
-    max_concurrency: int = 1
+    # None = unset: resolves to 1 for threaded actors, 1000 for async
+    # actors (reference: ray_constants DEFAULT_MAX_CONCURRENCY_ASYNC)
+    max_concurrency: Optional[int] = None
     # concurrency groups (reference: core_worker ConcurrencyGroupManager,
     # transport/task_receiver.h): group name -> thread count; methods are
     # routed to their group's lane so e.g. health/stats probes never queue

@@ -246,8 +246,12 @@ class Worker:
 
     # ----------------------------------------------------------- submission
 
-    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+    def submit_task(self, spec: TaskSpec):
         spec.owner = self.worker_id
+        if spec.streaming:
+            gen = self.backend.register_stream(spec)
+            self.backend.submit_task(spec)
+            return gen
         refs = [ObjectRef(oid, self.worker_id) for oid in spec.return_ids()]
         for oid in spec.return_ids():
             self.refcounter.mark_owned(oid)
@@ -258,8 +262,12 @@ class Worker:
         spec.owner = self.worker_id
         self.backend.create_actor(spec)
 
-    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+    def submit_actor_task(self, spec: TaskSpec):
         spec.owner = self.worker_id
+        if spec.streaming:
+            gen = self.backend.register_stream(spec)
+            self.backend.submit_actor_task(spec)
+            return gen
         refs = [ObjectRef(oid, self.worker_id) for oid in spec.return_ids()]
         for oid in spec.return_ids():
             self.refcounter.mark_owned(oid)

@@ -59,13 +59,15 @@ class RemoteFunction:
         worker = require_connected()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=worker.next_task_id(),
             name=opts.get("name") or self._function.__qualname__,
             function=self._function,
             args=worker.make_task_args(args),
             kwargs=dict(kwargs),
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming,
             resources=_build_resources(opts) or {"CPU": 1.0},
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
@@ -77,6 +79,8 @@ class RemoteFunction:
             spec.placement_bundle_index = opts.get(
                 "placement_group_bundle_index", -1)
         refs = worker.submit_task(spec)
+        if streaming:
+            return refs  # an ObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs

@@ -113,23 +113,34 @@ class _TaskSubmitter:
     # -- internals --
 
     def _pump(self) -> None:
-        """Assign pending tasks to idle leases; request more leases if short."""
+        """Assign pending tasks to idle leases; request more leases if short.
+
+        Lease requests in flight are capped (reference: the submitter
+        pipelines at most max_pending_lease_requests_per_scheduling_category
+        lease requests, normal_task_submitter.h:74) — without the cap, a
+        1000-task batch spawns a requester thread per task and the retry
+        storm starves the head's RPC pool of the pushes/replies that
+        actually drain the queue (measured: 75x throughput loss).
+        """
+        spawn = 0
         while True:
             with self.lock:
                 if not self.pending:
-                    return
+                    break
                 lease = next((l for l in self.leases.values() if not l.busy),
                              None)
                 if lease is None:
-                    need_more = (len(self.pending) >
-                                 self.requesting) and not self.backend._closed
-                    if need_more:
-                        self.requesting += 1
+                    if not self.backend._closed:
+                        cap = config_mod.GlobalConfig \
+                            .max_pending_lease_requests
+                        want = min(len(self.pending), cap)
+                        spawn = max(0, want - self.requesting)
+                        self.requesting += spawn
                     break
                 task = self.pending.popleft()
                 lease.busy = True
             self._push(lease, task)
-        if need_more:
+        for _ in range(spawn):
             threading.Thread(target=self._request_lease, daemon=True,
                              name="lease-req").start()
 
@@ -478,11 +489,16 @@ class ClusterBackend:
             worker, local_node_id, store, self.head, node_addrs, node_shm)
         self.local_node_id = local_node_id
 
+        # streaming-generator states by task id (reference: the owner-side
+        # streaming generator metadata in TaskManager)
+        self._streams: Dict[bytes, Any] = {}
+
         # owner service: every process is reachable for object resolution
         self.server = RpcServer({
             "get_object": self.object_plane.handle_get_object,
             "add_borrower": self.object_plane.handle_add_borrower,
             "remove_borrower": self.object_plane.handle_remove_borrower,
+            "stream_item": self._h_stream_item,
             "ping": lambda p, c: "pong",
         }, name=f"{role}-owner")
         self.head.call_retrying("kv_put", {
@@ -705,11 +721,46 @@ class ClusterBackend:
         pins.extend(r.id() for r in contained)
         return pins
 
+    # ------------------------------------------------------------- streaming
+
+    def register_stream(self, spec: TaskSpec):
+        """Create owner-side state + generator for a streaming task."""
+        from ray_tpu.core.generator import ObjectRefGenerator, StreamState
+        state = StreamState()
+        with self._lock:
+            self._streams[spec.task_id.binary()] = state
+        return ObjectRefGenerator(spec.task_id, self.worker.worker_id,
+                                  self.worker, state)
+
+    def _h_stream_item(self, p, ctx):
+        """A worker shipped one yielded value of a streaming task we own."""
+        oid = ObjectID(p["object_id"])
+        self.worker.refcounter.mark_owned(oid)
+        if "in_shm" in p:
+            self.object_plane.record_remote_location(oid, p["in_shm"])
+        else:
+            value = serialization.deserialize(p["inline"])
+            self.worker.memory_store.put(oid, value, is_error=False)
+        return True
+
+    def _finish_stream(self, spec: TaskSpec, total, error) -> None:
+        with self._lock:
+            state = self._streams.pop(spec.task_id.binary(), None)
+        if state is not None:
+            state.finish(total, error)
+
     def _store_task_reply(self, spec: TaskSpec, reply: dict,
                           pins: list) -> None:
         if reply.get("cancelled"):
             self._store_task_error(
                 spec, TaskCancelledError(spec.task_id.hex()), pins)
+            return
+        if spec.streaming:
+            error = None
+            if "streaming_error" in reply:
+                error = serialization.deserialize(reply["streaming_error"])
+            self._finish_stream(spec, reply.get("streaming_count"), error)
+            self._unpin(pins)
             return
         rids = spec.return_ids()
         for rid, res in zip(rids, reply["results"]):
@@ -723,6 +774,9 @@ class ClusterBackend:
 
     def _store_task_error(self, spec: TaskSpec, exc: BaseException,
                           pins: list) -> None:
+        if spec.streaming:
+            # no total recorded: consumer raises once received items drain
+            self._finish_stream(spec, None, exc)
         for rid in spec.return_ids():
             self.worker.memory_store.put(rid, exc, is_error=True)
         self._unpin(pins)
@@ -915,9 +969,11 @@ def start_head(session: str, port: Optional[int] = None,
 
 def start_node(head_addr: str, session: str,
                resources: Optional[Dict[str, float]] = None,
-               object_store_bytes: Optional[int] = None) -> subprocess.Popen:
+               object_store_bytes: Optional[int] = None,
+               node_id: Optional[str] = None) -> subprocess.Popen:
     args = {"resources": resources,
             "object_store_bytes": object_store_bytes,
+            "node_id": node_id,
             "config": json.loads(config_mod.GlobalConfig.to_json())}
     cmd = [sys.executable, "-m", "ray_tpu.runtime.node", head_addr, session,
            json.dumps(args)]

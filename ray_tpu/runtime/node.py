@@ -47,11 +47,14 @@ class NodeDaemon:
     def __init__(self, head_addr: str, session: str,
                  resources: Dict[str, float],
                  object_store_bytes: Optional[int] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 node_id: Optional[str] = None):
         cfg = config_mod.GlobalConfig
         self.head_addr = head_addr
         self.session = session
-        self.node_id = NodeID.from_random().hex()
+        # launcher-assigned id lets the autoscaler match a registration to
+        # the exact launch it came from (adoption by identity, not order)
+        self.node_id = node_id or NodeID.from_random().hex()
         self.resources = dict(resources)
         # TPU hosts advertise chip + gang resources (env-detected only —
         # a jax probe here would claim the chips; see accelerators/tpu.py)
@@ -394,7 +397,8 @@ def main() -> None:
     daemon = NodeDaemon(
         head_addr, session,
         resources=args.get("resources") or {"CPU": float(os.cpu_count() or 1)},
-        object_store_bytes=args.get("object_store_bytes"))
+        object_store_bytes=args.get("object_store_bytes"),
+        node_id=args.get("node_id"))
     signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
     print(f"RTPU_NODE_READY {daemon.address}", flush=True)
     try:

@@ -60,6 +60,7 @@ def task_to_wire(spec: TaskSpec, function_key: str = "") -> Tuple[dict, list]:
         "args": args,
         "kwargs": kw.to_bytes(),
         "num_returns": spec.num_returns,
+        "streaming": spec.streaming,
         "resources": spec.resources,
         "max_retries": spec.max_retries,
         "retry_exceptions": spec.retry_exceptions,
@@ -87,6 +88,7 @@ def task_from_wire(p: dict) -> TaskSpec:
         args=args,
         kwargs=p["kwargs"],  # serialized blob; executor deserializes
         num_returns=p["num_returns"],
+        streaming=p.get("streaming", False),
         resources=p["resources"],
         max_retries=p["max_retries"],
         retry_exceptions=p["retry_exceptions"],

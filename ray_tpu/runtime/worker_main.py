@@ -37,7 +37,7 @@ from ray_tpu.runtime.protocol import DEFERRED, RpcClient, RpcError
 
 
 class Executor:
-    """Serial (or n-threaded) execution of pushed tasks."""
+    """Serial (or n-threaded, or asyncio-loop) execution of pushed tasks."""
 
     def __init__(self, backend, worker):
         self.backend = backend
@@ -47,6 +47,11 @@ class Executor:
         self.cancelled: set = set()
         self.actor_instance: Optional[Any] = None
         self.actor_id: Optional[bytes] = None
+        # async actors: all methods run on this event loop (reference:
+        # fiber-based async execution, core_worker/transport/fiber.h role —
+        # here a plain asyncio loop thread + semaphore)
+        self._aio_loop = None
+        self._aio_sem = None
         self._threads: List[threading.Thread] = []
         # concurrency groups (reference: ConcurrencyGroupManager,
         # core_worker/transport/concurrency_group_manager.h): each group
@@ -143,8 +148,25 @@ class Executor:
             # single consumer is this thread — any extra consumer could
             # dequeue a method while __init__ is still in flight and see a
             # None instance.
-            if spec.get("max_concurrency", 1) > 1:
-                self._start_threads(spec["max_concurrency"])
+            import asyncio
+            import inspect
+            # scan the whole MRO (dir), not vars(cls): inherited coroutine
+            # methods must also flip the actor into async mode
+            is_async = any(
+                inspect.iscoroutinefunction(getattr(cls, n, None))
+                or inspect.isasyncgenfunction(getattr(cls, n, None))
+                for n in dir(cls))
+            mc = spec.get("max_concurrency")
+            if is_async:
+                # async actor: every method runs on one event loop; the
+                # semaphore bounds in-flight coroutines (reference default
+                # 1000 for async actors)
+                self._aio_loop = asyncio.new_event_loop()
+                self._aio_sem = asyncio.Semaphore(mc if mc else 1000)
+                threading.Thread(target=self._aio_loop.run_forever,
+                                 daemon=True, name="actor-aio").start()
+            elif mc and mc > 1:
+                self._start_threads(mc)
             for gname, gn in (spec.get("concurrency_groups") or {}).items():
                 gq: "queue.Queue" = queue.Queue()
                 self._group_queues[gname] = gq
@@ -173,10 +195,8 @@ class Executor:
         if task_id in self.cancelled:
             ctx.reply({"results": None, "cancelled": True})
             return
-        num_returns = payload["num_returns"]
         self.worker.current_task_id = TaskID(task_id)
         t_start = time.time()
-        ok = True
         try:
             args, kwargs = self._resolve_args(payload["args"],
                                               payload["kwargs"])
@@ -188,6 +208,13 @@ class Executor:
                 if method is None:
                     raise AttributeError(
                         f"actor has no method {payload['method_name']!r}")
+                if self._aio_loop is not None:
+                    # async actor: hand off to the loop WITHOUT blocking
+                    # this lane — that's what lets one replica interleave
+                    # many in-flight requests
+                    self._dispatch_async(method, args, kwargs, payload, ctx,
+                                         t_start)
+                    return
                 result = method(*args, **kwargs)
             else:
                 fn = self._resolve_function(payload["function_key"])
@@ -195,39 +222,58 @@ class Executor:
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, (SystemExit, KeyboardInterrupt)):
                 raise
-            ok = False
-            so = serialization.serialize_error(e)
-            ctx.reply({"results": [{"inline": so.to_bytes(),
-                                    "is_error": True}] * num_returns})
+            self._reply_error(payload, ctx, e, t_start)
             return
         finally:
             self.worker.current_task_id = None
-            # task span -> event buffer (flushed by the telemetry thread;
-            # reference: TaskEventBuffer state transitions)
-            buf = getattr(self.backend, "event_buffer", None)
-            if buf is not None:
-                buf.record(
-                    name=payload.get("name") or payload.get(
-                        "method_name") or "task",
-                    task_id=TaskID(task_id).hex()[:16],
-                    kind="actor_task" if payload.get("actor_id") else "task",
-                    start=t_start, end=time.time(), ok=ok)
-        # package results
+        if payload.get("streaming"):
+            self._stream_out(payload, ctx, result, t_start)
+            return
+        self._reply_ok(payload, ctx, result, t_start)
+
+    # ----------------------------------------------------- reply packaging
+
+    def _record_span(self, payload: dict, t_start: float, ok: bool) -> None:
+        # task span -> event buffer (flushed by the telemetry thread;
+        # reference: TaskEventBuffer state transitions)
+        buf = getattr(self.backend, "event_buffer", None)
+        if buf is not None:
+            buf.record(
+                name=payload.get("name") or payload.get(
+                    "method_name") or "task",
+                task_id=TaskID(payload["task_id"]).hex()[:16],
+                kind="actor_task" if payload.get("actor_id") else "task",
+                start=t_start, end=time.time(), ok=ok)
+
+    def _reply_error(self, payload: dict, ctx, exc: BaseException,
+                     t_start: float) -> None:
+        self._record_span(payload, t_start, ok=False)
+        so = serialization.serialize_error(exc)
+        n = max(1, payload["num_returns"])
+        if payload.get("streaming"):
+            ctx.reply({"streaming_count": 0,
+                       "streaming_error": so.to_bytes()})
+            return
+        ctx.reply({"results": [{"inline": so.to_bytes(),
+                                "is_error": True}] * n})
+
+    def _reply_ok(self, payload: dict, ctx, result: Any,
+                  t_start: float) -> None:
+        self._record_span(payload, t_start, ok=True)
+        num_returns = payload["num_returns"]
         if num_returns == 1:
             values = [result]
         else:
             if not isinstance(result, tuple) or len(result) != num_returns:
-                so = serialization.serialize_error(ValueError(
+                self._reply_error(payload, ctx, ValueError(
                     f"declared num_returns={num_returns} but returned "
-                    f"{type(result)}"))
-                ctx.reply({"results": [{"inline": so.to_bytes(),
-                                        "is_error": True}] * num_returns})
+                    f"{type(result)}"), t_start)
                 return
             values = list(result)
         cfg = config_mod.GlobalConfig
         results = []
         contained = []
-        tid = TaskID(task_id)
+        tid = TaskID(payload["task_id"])
         for i, v in enumerate(values):
             so = serialization.serialize(v)
             contained.extend(so.contained_refs)
@@ -243,6 +289,125 @@ class Executor:
         # owner registers its own borrows when it deserializes the reply
         for r in contained:
             self.worker.refcounter.on_serialized_ref_done(r.id())
+
+    # ------------------------------------------------------------ streaming
+
+    def _send_stream_item(self, owner_client, payload: dict, index: int,
+                          value: Any) -> None:
+        """Ship one yielded value to the owner (inline or via shm)."""
+        cfg = config_mod.GlobalConfig
+        oid = ObjectID.for_return(TaskID(payload["task_id"]), index)
+        so = serialization.serialize(value)
+        msg = {"task_id": payload["task_id"], "object_id": oid.binary(),
+               "index": index}
+        if so.total_bytes <= cfg.memory_store_threshold_bytes:
+            msg["inline"] = so.to_bytes()
+        else:
+            # creator pin released: the owner's ref is the only keeper, and
+            # streamed items are meant to be consumed-and-dropped
+            msg["in_shm"] = self.backend.object_plane.store_result_bytes(
+                oid, so.to_bytes())
+        owner_client.oneway("stream_item", msg)
+        for r in so.contained_refs:
+            self.worker.refcounter.on_serialized_ref_done(r.id())
+
+    def _stream_out(self, payload: dict, ctx, result: Any,
+                    t_start: float) -> None:
+        """Drain a generator task, shipping items as they are produced
+        (reference: streaming generator protocol, _raylet.pyx:1391)."""
+        owner = self.backend.object_plane.owner_client(
+            WorkerID(payload["owner"]))
+        i = 0
+        try:
+            for v in iter(result):
+                i += 1
+                self._send_stream_item(owner, payload, i, v)
+        except BaseException as e:  # noqa: BLE001
+            self._record_span(payload, t_start, ok=False)
+            so = serialization.serialize_error(e)
+            ctx.reply({"streaming_count": i,
+                       "streaming_error": so.to_bytes()})
+            return
+        self._record_span(payload, t_start, ok=True)
+        ctx.reply({"streaming_count": i})
+
+    # ---------------------------------------------------------- async actors
+
+    def _dispatch_async(self, method, args, kwargs, payload: dict, ctx,
+                        t_start: float) -> None:
+        import asyncio
+        import inspect
+
+        streaming = bool(payload.get("streaming"))
+
+        def _stream_reply(i: int, exc: Optional[BaseException]) -> None:
+            """Reply for a streaming call, preserving the count of items
+            already shipped so the consumer drains them before seeing the
+            error (same contract as the sync _stream_out path)."""
+            if exc is None:
+                self._record_span(payload, t_start, ok=True)
+                ctx.reply({"streaming_count": i})
+            else:
+                self._record_span(payload, t_start, ok=False)
+                so = serialization.serialize_error(exc)
+                ctx.reply({"streaming_count": i,
+                           "streaming_error": so.to_bytes()})
+
+        async def run():
+            async with self._aio_sem:
+                if inspect.isasyncgenfunction(method):
+                    if not streaming:
+                        raise TypeError(
+                            f"{payload['method_name']} is an async generator"
+                            f" — call it with num_returns='streaming'")
+                    owner = self.backend.object_plane.owner_client(
+                        WorkerID(payload["owner"]))
+                    i = 0
+                    try:
+                        async for v in method(*args, **kwargs):
+                            i += 1
+                            # blocking socket write; cheap enough on-loop
+                            # for token-sized payloads
+                            self._send_stream_item(owner, payload, i, v)
+                    except BaseException as e:  # noqa: BLE001
+                        _stream_reply(i, e)
+                        return None
+                    _stream_reply(i, None)
+                    return None
+                out = method(*args, **kwargs)
+                if inspect.isawaitable(out):
+                    out = await out
+                if streaming:
+                    owner = self.backend.object_plane.owner_client(
+                        WorkerID(payload["owner"]))
+                    i = 0
+                    try:
+                        for v in iter(out):
+                            i += 1
+                            self._send_stream_item(owner, payload, i, v)
+                    except BaseException as e:  # noqa: BLE001
+                        _stream_reply(i, e)
+                        return None
+                    _stream_reply(i, None)
+                    return None
+                return out
+
+        fut = asyncio.run_coroutine_threadsafe(run(), self._aio_loop)
+
+        def done(f):
+            try:
+                result = f.result()
+            except BaseException as e:  # noqa: BLE001
+                # streaming paths that started shipping replied already
+                # (ctx.reply is once-only); this covers pre-iteration
+                # failures and non-streaming errors
+                self._reply_error(payload, ctx, e, t_start)
+                return
+            if streaming:
+                return  # replied inside run() with the true item count
+            self._reply_ok(payload, ctx, result, t_start)
+
+        fut.add_done_callback(done)
 
 
 def pickle_loads(data: bytes):

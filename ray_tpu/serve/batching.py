@@ -112,7 +112,10 @@ def _method_queue(fn: Callable, owner: Any, max_batch_size: int,
 
 def _func_queue(fn: Callable, max_batch_size: int,
                 timeout_s: float) -> _BatchQueue:
-    key = getattr(fn, "__qualname__", repr(fn))
+    # module + qualname: qualname alone collides across modules and
+    # would route the second function's calls into the first's queue
+    key = (getattr(fn, "__module__", ""),
+           getattr(fn, "__qualname__", repr(fn)))
     with _CREATE_LOCK:
         q = _FUNC_QUEUES.get(key)
         if q is None:

@@ -84,6 +84,12 @@ class Router:
                 live = {h.actor_id.hex() for h in self._replicas}
                 self._inflight = {k: v for k, v in self._inflight.items()
                                   if k in live}
+                # drop pending watches on dead replicas too: their refs
+                # may never complete (replica killed, reply lost), and
+                # without this they'd be rescanned by every reap round
+                # forever (advisor r2 slow leak)
+                self._pending = [(k, r) for k, r in self._pending
+                                 if k in live]
             self._fetched_at = now
 
     def _pick(self):

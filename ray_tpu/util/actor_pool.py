@@ -19,38 +19,38 @@ class ActorPool:
         if not actors:
             raise ValueError("ActorPool needs at least one actor")
         self._idle = list(actors)
-        self._pending_submits: collections.deque = collections.deque()
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
+        self._backlog: collections.deque = collections.deque()
+        self._inflight = {}
+        self._ref_by_seq = {}
+        self._submit_seq = 0
+        self._drain_seq = 0
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """fn(actor, value) -> ObjectRef. With no free actor the call is
         queued and dispatched when a result is consumed (reference
         semantics: get_next frees the actor, which drains the queue)."""
         if not self._idle:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
             return
         actor = self._idle.pop()
         ref = fn(actor, value)
-        self._future_to_actor[ref] = (self._next_task_index, actor)
-        self._index_to_future[self._next_task_index] = ref
-        self._next_task_index += 1
+        self._inflight[ref] = (self._submit_seq, actor)
+        self._ref_by_seq[self._submit_seq] = ref
+        self._submit_seq += 1
 
     def _return_actor(self, actor: Any) -> None:
         self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.popleft()
+        if self._backlog:
+            fn, value = self._backlog.popleft()
             self.submit(fn, value)
 
     def get_next(self, timeout: float = 300.0) -> Any:
         """Next result in SUBMISSION order."""
-        if self._next_return_index >= self._next_task_index:
+        if self._drain_seq >= self._submit_seq:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(ref)
+        ref = self._ref_by_seq.pop(self._drain_seq)
+        self._drain_seq += 1
+        _, actor = self._inflight.pop(ref)
         try:
             return ray_tpu.get(ref, timeout=timeout)
         finally:
@@ -58,15 +58,15 @@ class ActorPool:
 
     def get_next_unordered(self, timeout: float = 300.0) -> Any:
         """Next result in COMPLETION order."""
-        if not self._future_to_actor:
+        if not self._inflight:
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        ready, _ = ray_tpu.wait(list(self._inflight),
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("no result within timeout")
         ref = ready[0]
-        idx, actor = self._future_to_actor.pop(ref)
-        self._index_to_future.pop(idx, None)
+        idx, actor = self._inflight.pop(ref)
+        self._ref_by_seq.pop(idx, None)
         try:
             return ray_tpu.get(ref, timeout=timeout)
         finally:
@@ -88,7 +88,7 @@ class ActorPool:
             yield self.get_next_unordered()
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor or self._pending_submits)
+        return bool(self._inflight or self._backlog)
 
     def has_free(self) -> bool:
         return bool(self._idle)

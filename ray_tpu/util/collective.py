@@ -44,24 +44,28 @@ class _GroupActor:
         self._lock = threading.Lock()
         self._rounds: Dict[str, dict] = {}
 
-    def _round(self, key: str) -> dict:
+    def _round_locked(self, key: str) -> dict:
+        """Sweep expired rounds and return (creating) `key`'s round.
+        MUST be called with self._lock held: sweep+lookup+mutation stay
+        one atomic section, so a concurrent sweep can never delete the
+        round between lookup and deposit (advisor r2: orphaned-dict
+        deposit left every rank blocked until timeout)."""
         now = time.monotonic()
-        with self._lock:
-            for k in [k for k, r in self._rounds.items()
-                      if now - r["created"] > self.ROUND_TTL_S]:
-                del self._rounds[k]
-            r = self._rounds.get(key)
-            if r is None:
-                r = {"contribs": {}, "result": None, "done": False,
-                     "created": now}
-                self._rounds[key] = r
-            return r
+        for k in [k for k, r in self._rounds.items()
+                  if now - r["created"] > self.ROUND_TTL_S]:
+            del self._rounds[k]
+        r = self._rounds.get(key)
+        if r is None:
+            r = {"contribs": {}, "result": None, "done": False,
+                 "created": now}
+            self._rounds[key] = r
+        return r
 
     def contribute(self, key: str, rank: int, value: Any, op: str,
                    kind: str) -> bool:
         """Deposit rank's tensor; the LAST depositor computes the result."""
-        r = self._round(key)
         with self._lock:
+            r = self._round_locked(key)
             r["contribs"][rank] = value
             if len(r["contribs"]) < self.world_size:
                 return False
@@ -81,8 +85,8 @@ class _GroupActor:
             return True
 
     def fetch(self, key: str, rank: int, kind: str):
-        r = self._round(key)
         with self._lock:
+            r = self._round_locked(key)
             if not r["done"]:
                 return None
             if kind == "reducescatter":
