@@ -35,6 +35,9 @@ class ActorClass:
         for k in self._options:
             if k not in _VALID_ACTOR_OPTIONS:
                 raise ValueError(f"invalid option {k!r} for actor @remote")
+        from ray_tpu.runtime import runtime_env as rtenv
+        self._options["runtime_env"] = rtenv.validate(
+            self._options.get("runtime_env"))
         # Collect per-method defaults declared with @ray_tpu.method(...).
         self._method_options: Dict[str, Dict[str, Any]] = {}
         for name in dir(cls):
@@ -95,6 +98,7 @@ class ActorClass:
                 if o.get("concurrency_group")},
             lifetime=opts.get("lifetime") or "non_detached",
             scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
         )
         pg = opts.get("placement_group")
         if pg is not None:

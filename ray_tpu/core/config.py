@@ -45,6 +45,8 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     # --- health / fault tolerance ---
     "health_check_period_ms": (int, 1000, "GCS -> node ping period"),
     "health_check_timeout_ms": (int, 5000, "missed-deadline before node marked dead"),
+    "node_head_watch_period_s": (float, 0.5, "node -> head liveness/incarnation poll period"),
+    "head_recovery_grace_s": (float, 5.0, "restarted head waits this long for nodes to re-register before declaring unreconciled actors/PGs lost"),
     "task_max_retries_default": (int, 3, "default retries for normal tasks"),
     "infeasible_grace_s": (float, 30.0, "wait for autoscaling before failing infeasible resource shapes"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),

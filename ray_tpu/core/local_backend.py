@@ -10,6 +10,7 @@ max_restarts are honored, resource limits gate concurrency.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import traceback
@@ -20,6 +21,46 @@ from ray_tpu.core.ids import ActorID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskArg, TaskSpec
 from ray_tpu.exceptions import (ActorDiedError, TaskCancelledError, TaskError)
+
+# Local mode runs tasks as threads in ONE process, so env_vars are applied
+# to os.environ around the call. The lock covers only the mutate/restore
+# steps (holding it across user code would deadlock a nested env'd
+# ray.get()); concurrent env'd tasks can therefore observe each other's
+# variables — a documented dev-mode tradeoff, since true isolation needs
+# the cluster runtime's per-env worker processes.
+_env_lock = threading.Lock()
+
+
+class _applied_runtime_env:
+    def __init__(self, renv):
+        self.renv = renv or None
+        self._saved = None
+
+    def __enter__(self):
+        if self.renv is None:
+            return self
+        if "working_dir" in self.renv:
+            raise ValueError(
+                "runtime_env['working_dir'] requires the cluster runtime "
+                "(per-env worker processes); local_mode runs in-process — "
+                "use ray_tpu.init() without local_mode=True")
+        env_vars = self.renv.get("env_vars") or {}
+        if env_vars:
+            with _env_lock:
+                self._saved = {k: os.environ.get(k) for k in env_vars}
+                os.environ.update(env_vars)
+        return self
+
+    def __exit__(self, *exc):
+        if self._saved is not None:
+            with _env_lock:
+                for k, v in self._saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            self._saved = None
+        return False
 
 
 class _LocalActor:
@@ -40,7 +81,8 @@ class _LocalActor:
         import asyncio
         import inspect
         args = self.backend._resolve_args(self.spec.args)
-        self.instance = self.spec.cls(*args, **self.spec.kwargs)
+        with _applied_runtime_env(self.spec.runtime_env):
+            self.instance = self.spec.cls(*args, **self.spec.kwargs)
         cls = type(self.instance)
         if any(inspect.iscoroutinefunction(getattr(cls, n, None))
                or inspect.isasyncgenfunction(getattr(cls, n, None))
@@ -80,10 +122,12 @@ class _LocalActor:
                     self._submit_async(method, args, spec)
                     continue
                 if spec.streaming:
-                    self.backend._drain_stream(spec, method(*args,
-                                                            **spec.kwargs))
+                    with _applied_runtime_env(self.spec.runtime_env):
+                        self.backend._drain_stream(
+                            spec, method(*args, **spec.kwargs))
                     continue
-                result = method(*args, **spec.kwargs)
+                with _applied_runtime_env(self.spec.runtime_env):
+                    result = method(*args, **spec.kwargs)
                 self.backend._store_result(spec, result)
             except BaseException as e:  # noqa: BLE001
                 if isinstance(e, (SystemExit, KeyboardInterrupt)):
@@ -100,6 +144,10 @@ class _LocalActor:
         import inspect
 
         async def run():
+            with _applied_runtime_env(self.spec.runtime_env):
+                return await _run_inner()
+
+        async def _run_inner():
             if inspect.isasyncgenfunction(method):
                 if not spec.streaming:
                     raise TypeError(
@@ -176,6 +224,7 @@ class LocalBackend:
         self.actors: Dict[ActorID, _LocalActor] = {}
         self.named_actors: Dict[str, ActorID] = {}
         self.cancelled: set = set()
+        self._streams: Dict[bytes, Any] = {}
         self._lock = threading.Lock()
         self.resources = {"CPU": float(n), **(resources or {})}
 
@@ -245,23 +294,20 @@ class LocalBackend:
         from ray_tpu.core.generator import ObjectRefGenerator, StreamState
         state = StreamState()
         with self._lock:
-            if not hasattr(self, "_streams"):
-                self._streams: Dict[bytes, Any] = {}
             self._streams[spec.task_id.binary()] = state
         return ObjectRefGenerator(spec.task_id, self.worker.worker_id,
                                   self.worker, state)
 
     def _stream_state(self, spec: TaskSpec):
         with self._lock:
-            return getattr(self, "_streams", {}).get(spec.task_id.binary())
+            return self._streams.get(spec.task_id.binary())
 
     def _finish_stream(self, spec: TaskSpec, total, error) -> None:
         """Complete + drop the stream state (popping mirrors the cluster
         backend's _finish_stream — a long-lived driver must not accumulate
         one StreamState per streaming call)."""
         with self._lock:
-            state = getattr(self, "_streams", {}).pop(
-                spec.task_id.binary(), None)
+            state = self._streams.pop(spec.task_id.binary(), None)
         if state is not None:
             if error is not None and not isinstance(
                     error, (TaskError, ActorDiedError, TaskCancelledError)):
@@ -297,10 +343,11 @@ class LocalBackend:
                 return
             try:
                 args = self._resolve_args(spec.args)
-                result = spec.function(*args, **spec.kwargs)
-                if spec.streaming:
-                    self._drain_stream(spec, result)
-                    return
+                with _applied_runtime_env(spec.runtime_env):
+                    result = spec.function(*args, **spec.kwargs)
+                    if spec.streaming:
+                        self._drain_stream(spec, result)
+                        return
                 self._store_result(spec, result)
             except BaseException as e:  # noqa: BLE001
                 # In local mode every failure is an application error, so the

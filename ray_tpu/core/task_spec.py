@@ -50,6 +50,9 @@ class TaskSpec:
     scheduling_strategy: Any = None
     placement_group_id: Optional[bytes] = None
     placement_bundle_index: int = -1
+    # validated runtime environment (env_vars/working_dir — see
+    # runtime/runtime_env.py; reference: common.proto RuntimeEnvInfo)
+    runtime_env: Optional[dict] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -93,3 +96,4 @@ class ActorCreationSpec:
     placement_group_id: Optional[bytes] = None
     placement_bundle_index: int = -1
     owner: Optional[WorkerID] = None
+    runtime_env: Optional[dict] = None

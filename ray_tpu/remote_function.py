@@ -43,6 +43,11 @@ class RemoteFunction:
         for k in self._options:
             if k not in _VALID_OPTIONS:
                 raise ValueError(f"invalid option {k!r} for @remote")
+        # fail-fast on unsupported/malformed envs at decoration time —
+        # never silently dropped (reference: runtime_env plugin validation)
+        from ray_tpu.runtime import runtime_env as rtenv
+        self._options["runtime_env"] = rtenv.validate(
+            self._options.get("runtime_env"))
         functools.update_wrapper(self, function)
         self._exported_key: Optional[bytes] = None
 
@@ -72,6 +77,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
         )
         pg = opts.get("placement_group")
         if pg is not None:

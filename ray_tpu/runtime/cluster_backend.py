@@ -51,12 +51,15 @@ from ray_tpu.runtime.protocol import (ClientPool, RpcClient, RpcError,
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker_addr", "worker_id", "busy", "idle_since")
+    __slots__ = ("lease_id", "worker_addr", "worker_id", "node_addr",
+                 "busy", "idle_since")
 
-    def __init__(self, lease_id: str, worker_addr: str, worker_id: bytes):
+    def __init__(self, lease_id: str, worker_addr: str, worker_id: bytes,
+                 node_addr: str = ""):
         self.lease_id = lease_id
         self.worker_addr = worker_addr
         self.worker_id = worker_id
+        self.node_addr = node_addr
         self.busy = False
         self.idle_since = time.monotonic()
 
@@ -76,11 +79,13 @@ class _TaskSubmitter:
 
     def __init__(self, backend: "ClusterBackend", shape_key: tuple,
                  resources: Dict[str, float],
-                 pg: Optional[Tuple[bytes, int]] = None):
+                 pg: Optional[Tuple[bytes, int]] = None,
+                 runtime_env: Optional[dict] = None):
         self.backend = backend
         self.shape_key = shape_key
         self.resources = resources
         self.pg = pg
+        self.runtime_env = runtime_env
         self.pending: collections.deque = collections.deque()
         self.leases: Dict[str, _Lease] = {}
         self.requesting = 0
@@ -154,6 +159,8 @@ class _TaskSubmitter:
                     n_pending = len(self.pending)
                 payload = {"resources": self.resources,
                            "pending": n_pending}
+                if self.runtime_env is not None:
+                    payload["runtime_env"] = self.runtime_env
                 if self.pg is not None:
                     payload["pg_id"], payload["bundle_index"] = self.pg
                 try:
@@ -195,7 +202,8 @@ class _TaskSubmitter:
                     time.sleep(0.05)
                     continue
                 lease = _Lease(grant["lease_id"], grant["worker_addr"],
-                               grant["worker_id"])
+                               grant["worker_id"],
+                               node_addr=grant.get("node_addr", ""))
                 with self.lock:
                     self.leases[lease.lease_id] = lease
                 break
@@ -244,15 +252,49 @@ class _TaskSubmitter:
                     f"(attempt {task.attempts}): {exc}"),
                 task.pins)
 
+    def _release_to_cluster(self, lease: _Lease, timeout: float = 5.0) -> None:
+        """Release via the head; if the head forgot the lease (it restarted
+        and leases are process state), return the worker straight to its
+        node daemon so the pool slot isn't leaked.
+
+        The fallback fires ONLY on an explicit "unknown lease" reply. A
+        transport failure is ambiguous — the head may have completed the
+        release after we gave up, after which the worker can be re-leased
+        to someone else, and a late direct return would hand one worker to
+        two leases. Leaking a slot on an unreachable head is the safe side.
+        """
+        try:
+            known = bool(self.backend.head.call(
+                "release_lease", {"lease_id": lease.lease_id},
+                timeout=timeout))
+        except RpcError:
+            return
+        if not known and lease.node_addr:
+            # "unknown lease" has two causes: the head restarted (fall back
+            # — nobody else will free the worker), or THIS head already
+            # reclaimed it via our own connection blip (_on_client_disconnect)
+            # — in which case the worker may be re-leased already and a
+            # direct return would hand it to two leases. Lease ids embed the
+            # granting head's incarnation; only fall back across a change.
+            try:
+                pong = self.backend.head.call("ping", timeout=timeout)
+            except RpcError:
+                return
+            inc = pong.get("incarnation") if isinstance(pong, dict) else None
+            if inc is None or lease.lease_id.startswith(f"l{inc}."):
+                return
+            try:
+                self.backend.peers.get(lease.node_addr).call(
+                    "return_worker", {"worker_id": lease.worker_id},
+                    timeout=timeout)
+            except RpcError:
+                pass
+
     def _drop_lease(self, lease: _Lease) -> None:
         with self.lock:
             self.leases.pop(lease.lease_id, None)
         self.backend.peers.invalidate(lease.worker_addr)
-        try:
-            self.backend.head.call("release_lease",
-                                   {"lease_id": lease.lease_id}, timeout=5.0)
-        except RpcError:
-            pass
+        self._release_to_cluster(lease)
 
     def reap_idle(self, linger_s: float) -> None:
         now = time.monotonic()
@@ -265,24 +307,14 @@ class _TaskSubmitter:
                 if lease.busy:
                     continue
                 self.leases.pop(lease.lease_id, None)
-            try:
-                self.backend.head.call("release_lease",
-                                       {"lease_id": lease.lease_id},
-                                       timeout=5.0)
-            except RpcError:
-                pass
+            self._release_to_cluster(lease)
 
     def shutdown(self) -> None:
         with self.lock:
             leases = list(self.leases.values())
             self.leases.clear()
         for lease in leases:
-            try:
-                self.backend.head.call("release_lease",
-                                       {"lease_id": lease.lease_id},
-                                       timeout=2.0)
-            except RpcError:
-                pass
+            self._release_to_cluster(lease, timeout=2.0)
 
 
 class _ActorSubmitter:
@@ -458,6 +490,9 @@ class ClusterBackend:
         self._actor_submitters: Dict[ActorID, _ActorSubmitter] = {}
         self._actor_name_cache: Dict[str, dict] = {}
         self._export_epoch = os.urandom(8).hex()  # per-backend cache tag
+        # working_dir path -> uploaded package uri (upload-once semantics,
+        # reference: runtime_env working_dir URI cache)
+        self._rtenv_uploads: Dict[str, str] = {}
         # owner-side lineage: return-object id -> creating TaskSpec, so a
         # lost shm object can be rebuilt by re-executing its task
         # (reference: ObjectRecoveryManager, object_recovery_manager.h:38,
@@ -662,6 +697,30 @@ class ClusterBackend:
             pass  # unsettable callables just re-export every call
         return key
 
+    def resolve_runtime_env(self, descriptor: Optional[dict]
+                            ) -> Optional[dict]:
+        """Upload-once packaging: working_dir paths become content-hash
+        URIs in the head KV; env_vars pass through (reference:
+        runtime_env working_dir.py upload_package_if_needed)."""
+        if not descriptor:
+            return None
+        from ray_tpu.runtime import runtime_env as rtenv
+        out = dict(descriptor)
+        wd = out.pop("working_dir", None)
+        if wd is not None:
+            wd = os.path.abspath(wd)
+            with self._lock:
+                uri = self._rtenv_uploads.get(wd)
+            if uri is None:
+                uri, blob = rtenv.package_working_dir(wd)
+                self.head.call_retrying("kv_put", {
+                    "key": rtenv.kv_key(uri), "value": blob,
+                    "overwrite": False})
+                with self._lock:
+                    self._rtenv_uploads[wd] = uri
+            out["working_dir_uri"] = uri
+        return out or None
+
     def submit_task(self, spec: TaskSpec) -> None:
         key = self._export_function(spec.function)
         payload, contained = wire.task_to_wire(spec, function_key=key)
@@ -669,12 +728,15 @@ class ClusterBackend:
         pg = None
         if spec.placement_group_id is not None:
             pg = (spec.placement_group_id, spec.placement_bundle_index)
-        shape_key = (tuple(sorted(spec.resources.items())), pg)
+        renv = self.resolve_runtime_env(spec.runtime_env)
+        from ray_tpu.runtime.runtime_env import descriptor_key
+        shape_key = (tuple(sorted(spec.resources.items())), pg,
+                     descriptor_key(renv))
         with self._lock:
             sub = self._submitters.get(shape_key)
             if sub is None:
                 sub = _TaskSubmitter(self, shape_key, dict(spec.resources),
-                                     pg=pg)
+                                     pg=pg, runtime_env=renv)
                 self._submitters[shape_key] = sub
             # lineage: stateless tasks only (actor calls mutate state and
             # cannot be replayed — reference restriction)
@@ -813,6 +875,7 @@ class ClusterBackend:
             "class_name": spec.name,
             "pg_id": spec.placement_group_id,
             "bundle_index": spec.placement_bundle_index,
+            "runtime_env": self.resolve_runtime_env(spec.runtime_env),
         })
         with self._lock:
             self._actor_submitters[spec.actor_id] = _ActorSubmitter(

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import collections
 import json
+import os
+import pickle
 import sys
 import threading
 import time
@@ -78,7 +80,8 @@ class _ActorEntry:
     __slots__ = ("actor_id", "spec_bytes", "state", "address", "node_id",
                  "worker_id", "restarts_left", "max_task_retries", "reason",
                  "name_key", "resources", "owner_addr", "class_name",
-                 "num_restarts", "pg", "lease_resources", "pg_drawn_bundle")
+                 "num_restarts", "pg", "lease_resources", "pg_drawn_bundle",
+                 "runtime_env")
 
     def __init__(self, actor_id: bytes, spec_bytes: bytes, restarts_left: int,
                  max_task_retries: int, name_key: str,
@@ -104,6 +107,7 @@ class _ActorEntry:
         # bundle reservation
         self.lease_resources = dict(resources)
         self.pg_drawn_bundle: Optional[int] = None
+        self.runtime_env: Optional[dict] = None
 
 
 class _LeaseEntry:
@@ -128,15 +132,34 @@ class Head:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  session: str = "", persist_path: str = ""):
         self.session = session
-        # KV durability (reference: GCS table persistence via Redis,
-        # store_client/redis_store_client.h — scoped here to the KV table
-        # + job records: actors/leases are process state and die with
-        # their processes; a restarted head serves KV-backed data again)
+        # Distinguishes head processes across restarts: node daemons compare
+        # it on every liveness poll and re-register when it changes
+        # (reference: GCS restart detection — raylets reconnect and actors
+        # re-resolve, gcs_server/gcs_init_data.h + gcs_actor_manager.h:324).
+        self.incarnation = os.urandom(4).hex()
+        # Table durability (reference: GCS table persistence via Redis,
+        # store_client/redis_store_client.h): KV + job counter + actor
+        # directory + placement groups snapshot to disk; on restart the
+        # tables are rebuilt and reconciled against re-registering nodes.
+        # Leases are deliberately NOT persisted — they are bound to client
+        # connections, and clients fall back to returning leased workers
+        # directly to their node when the head forgot the lease.
         self._persist_path = persist_path
         self._persist_dirty = False
         # serializes snapshot WRITES (persist loop vs stop(): two threads
         # sharing one .tmp path would interleave into a torn pickle)
         self._persist_write_lock = threading.Lock()
+        # prompt-flush signal: rare-but-important transitions (actor
+        # ready/dead, PG created) kick the persist loop instead of waiting
+        # out the 1s batch tick, narrowing the window a hard head kill can
+        # lose a transition in (KV writes stay batched)
+        self._persist_kick = threading.Event()
+        # actor_ids/pg_ids restored from a snapshot, awaiting a node
+        # re-registration that claims them; swept after the recovery grace
+        self._recovering_actors: set = set()
+        self._recovering_pgs: set = set()
+        # restored actors that had no worker yet: re-placed at boot
+        self._respawn_on_boot: list = []
         self.cluster = ClusterState()
         cfg = config_mod.GlobalConfig
         self.cluster.set_spread_threshold(cfg.scheduler_spread_threshold)
@@ -146,15 +169,15 @@ class Head:
         self._named: Dict[str, bytes] = {}  # "ns:name" -> actor_id
         self._actor_by_worker: Dict[bytes, bytes] = {}  # worker_id -> actor_id
         self._kv: Dict[str, bytes] = {}
+        self._pgs: Dict[bytes, dict] = {}  # PlacementGroupID bin -> info
+        self._next_job = 0
         if self._persist_path:
             # restore BEFORE the RPC server exists: a client whose ping
             # succeeded must never read a miss on persisted keys or have
             # a fresh put clobbered by the stale snapshot applying late
-            self._load_kv()
+            self._load_snapshot()
         self._leases: Dict[str, _LeaseEntry] = {}
         self._lease_counter = 0
-        self._next_job = 0
-        self._pgs: Dict[bytes, dict] = {}  # PlacementGroupID bin -> info
         # telemetry (reference: GcsTaskManager events + metrics agent):
         # per-worker metric snapshots + bounded task-span ring buffer
         self._metrics: Dict[str, dict] = {}
@@ -195,7 +218,8 @@ class Head:
             "metrics_dump": self._h_metrics_dump,
             "timeline_dump": self._h_timeline_dump,
             "autoscaler_state": self._h_autoscaler_state,
-            "ping": lambda p, c: "pong",
+            "ping": lambda p, c: {"pong": True,
+                                  "incarnation": self.incarnation},
         }, host=host, port=port, max_workers=32, name="head")
         # a crashed client can't release its leases; reclaim them when its
         # connection drops (reference: raylet returns leased workers when
@@ -209,33 +233,101 @@ class Head:
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="head-health")
         self._health_thread.start()
+        if self._recovering_actors or self._recovering_pgs:
+            threading.Thread(target=self._recovery_grace_loop, daemon=True,
+                             name="head-recovery").start()
+        for entry in self._respawn_on_boot:
+            self._spawn_actor(entry)
+        self._respawn_on_boot = []
 
-    # -------------------------------------------------------- KV durability
+    # ----------------------------------------------------- table durability
 
-    def _load_kv(self) -> None:
-        import os
-        import pickle
+    #: _ActorEntry fields snapshotted verbatim (placement fields are
+    #: deliberately excluded: node_id/worker_id/address are reconciled
+    #: against re-registering nodes, never trusted from disk)
+    _ACTOR_PERSIST_FIELDS = ("spec_bytes", "state", "restarts_left",
+                             "max_task_retries", "reason", "name_key",
+                             "resources", "owner_addr", "class_name",
+                             "num_restarts", "pg", "lease_resources",
+                             "runtime_env")
+
+    def _load_snapshot(self) -> None:
         if not os.path.exists(self._persist_path):
             return  # fresh cluster: nothing to restore
         try:
             with open(self._persist_path, "rb") as f:
                 data = pickle.load(f)
         except Exception as e:  # noqa: BLE001 — unreadable/torn snapshot
-            print(f"WARNING: discarding unreadable KV snapshot "
+            print(f"WARNING: discarding unreadable head snapshot "
                   f"{self._persist_path}: {e!r}", file=sys.stderr,
                   flush=True)
             return
         with self._lock:
             self._kv.update(data.get("kv", {}))
+            self._next_job = max(self._next_job, data.get("next_job", 0))
+            for rec in data.get("actors", ()):
+                entry = _ActorEntry(rec["actor_id"], rec["spec_bytes"],
+                                    rec["restarts_left"],
+                                    rec["max_task_retries"], rec["name_key"],
+                                    rec["resources"], rec["owner_addr"],
+                                    rec["class_name"])
+                for f in self._ACTOR_PERSIST_FIELDS:
+                    if f in rec:
+                        setattr(entry, f, rec[f])
+                if entry.state != DEAD:
+                    if rec.get("had_worker"):
+                        # was live when the snapshot landed: hold in
+                        # RESTARTING until its node re-registers and claims
+                        # the still-running worker, or the grace expires
+                        entry.state = RESTARTING
+                        self._recovering_actors.add(entry.actor_id)
+                    else:
+                        # never had a worker (placement was in flight and
+                        # died with the old head): place it fresh instead
+                        # of burning a restart in the lost-worker path.
+                        # Bump the fencing epoch WITHOUT consuming a
+                        # restart: if a stale snapshot hid a worker that
+                        # did start, its actor_ready carries the old
+                        # num_restarts and is rejected, and re-registration
+                        # kills it as unclaimed.
+                        entry.state = PENDING
+                        entry.num_restarts += 1
+                        self._respawn_on_boot.append(entry)
+                self._actors[entry.actor_id] = entry
+            self._named.update(data.get("named", {}))
+            for pg_id, pg in data.get("pgs", {}).items():
+                pg = dict(pg)
+                # lease draws died with their clients; actor draws are
+                # re-established on reconcile
+                pg["used"] = [dict() for _ in pg["bundles"]]
+                if pg["state"] == "CREATED":
+                    # keep the node mapping provisionally; bundles are
+                    # re-acquired per node as nodes return (grace sweep
+                    # reschedules pgs whose nodes never come back)
+                    pg["_acq"] = set()
+                    self._recovering_pgs.add(pg_id)
+                self._pgs[pg_id] = pg
 
-    def _save_kv(self) -> None:
-        import os
-        import pickle
+    def _save_snapshot(self) -> None:
         with self._persist_write_lock:
             with self._lock:
                 if not self._persist_dirty:
                     return
-                snap = {"kv": dict(self._kv)}
+                actors = []
+                for aid, e in self._actors.items():
+                    rec = {"actor_id": aid,
+                           "had_worker": e.worker_id is not None}
+                    for f in self._ACTOR_PERSIST_FIELDS:
+                        rec[f] = getattr(e, f)
+                    actors.append(rec)
+                pgs = {}
+                for pid, pg in self._pgs.items():
+                    pgs[pid] = {k: pg[k] for k in
+                                ("bundles", "nodes", "state", "strategy",
+                                 "name")}
+                snap = {"kv": dict(self._kv), "next_job": self._next_job,
+                        "actors": actors, "named": dict(self._named),
+                        "pgs": pgs}
                 self._persist_dirty = False
             try:
                 tmp = self._persist_path + ".tmp"
@@ -252,22 +344,141 @@ class Head:
                 raise
 
     def _persist_loop(self) -> None:
-        while not self._stopped.wait(1.0):
+        while not self._stopped.is_set():
+            self._persist_kick.wait(timeout=1.0)
+            self._persist_kick.clear()
+            if self._stopped.is_set():
+                return  # stop() takes the final snapshot itself
             try:
-                self._save_kv()
+                self._save_snapshot()
             except Exception:  # noqa: BLE001
                 pass
+
+    # ------------------------------------------------------ restart recovery
+
+    def _recovery_grace_loop(self) -> None:
+        """After a restart, wait for nodes to re-register and claim the
+        restored actors/PGs; whatever is still unclaimed when the grace
+        expires is treated as lost (actors take the normal restart path,
+        PGs go back to PENDING and reschedule)."""
+        grace = config_mod.GlobalConfig.head_recovery_grace_s
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and not self._stopped.is_set():
+            with self._lock:
+                if not self._recovering_actors and not self._recovering_pgs:
+                    return
+            time.sleep(0.1)
+        displaced: List[tuple] = []  # (actor_id, node_addr, worker_id)
+        with self._lock:
+            lost_actors = [aid for aid in self._recovering_actors
+                           if aid in self._actors]
+            self._recovering_actors.clear()
+            lost_pgs = list(self._recovering_pgs)
+            self._recovering_pgs.clear()
+            for pg_id in lost_pgs:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    continue
+                # release what partial re-acquisition happened, then let
+                # the scheduler place the whole group fresh
+                for idx in pg.pop("_acq", ()):
+                    node_id = pg["nodes"][idx]
+                    if node_id in self._nodes and self._nodes[node_id].alive:
+                        self.cluster.release(node_id, pg["bundles"][idx])
+                pg["state"] = "PENDING"
+                pg["nodes"] = None
+                pg["used"] = [dict() for _ in pg["bundles"]]
+                self._persist_dirty = True
+                # actors already reconciled into this group are now running
+                # outside any reservation: displace them so the restart
+                # path re-places them once the group reschedules
+                for aid, e in self._actors.items():
+                    if e.pg is not None and e.pg[0] == pg_id and \
+                            e.state == ALIVE and e.worker_id is not None:
+                        node = self._nodes.get(e.node_id)
+                        displaced.append(
+                            (aid, node.address if node is not None and
+                             node.alive else None, e.worker_id))
+        for aid, node_addr, worker_id in displaced:
+            if node_addr is not None:
+                try:
+                    self._node_clients.get(node_addr).call(
+                        "kill_worker", {"worker_id": worker_id})
+                except RpcError:
+                    pass
+            self._on_actor_worker_lost(
+                aid, "placement group rescheduled after head restart")
+        for aid in lost_actors:
+            self._on_actor_worker_lost(
+                aid, "worker lost across head restart")
+        if lost_pgs:
+            self._try_schedule_pgs()
 
     # ------------------------------------------------------------- membership
 
     def _h_register_node(self, p, ctx):
+        """Admit (or re-admit) a node. A re-registration after a head
+        restart carries the node's still-running actor workers; the head
+        claims them for the restored actor entries and tells the node to
+        kill workers whose actors it no longer knows (reference: raylet
+        reconnect after GCS restart — gcs_init_data.h rebuild + actor
+        re-resolution, gcs_actor_manager.h:324)."""
         node_id = p["node_id"]
+        kill: List[bytes] = []
         with self._lock:
-            entry = _NodeEntry(node_id, p["address"], p["shm_name"],
-                               p["resources"])
-            self._nodes[node_id] = entry
-            self.cluster.add_node(node_id, p["resources"])
-        return {"session": self.session}
+            known = self._nodes.get(node_id)
+            if known is None or not known.alive:
+                entry = _NodeEntry(node_id, p["address"], p["shm_name"],
+                                   p["resources"])
+                self._nodes[node_id] = entry
+                self.cluster.add_node(node_id, p["resources"])
+            else:
+                # idempotent re-register (e.g. a transient network blip on
+                # the node side, same head incarnation): refresh liveness
+                known.address = p["address"]
+                known.last_seen = time.monotonic()
+                known.missed = 0
+            # re-acquire bundle reservations for recovering PGs mapped here
+            for pg_id in list(self._recovering_pgs):
+                pg = self._pgs.get(pg_id)
+                if pg is None or pg.get("nodes") is None:
+                    self._recovering_pgs.discard(pg_id)
+                    continue
+                for idx, nid in enumerate(pg["nodes"]):
+                    if nid == node_id and idx not in pg["_acq"]:
+                        if self.cluster.acquire(node_id, pg["bundles"][idx]):
+                            pg["_acq"].add(idx)
+                if len(pg["_acq"]) == len(pg["bundles"]):
+                    pg.pop("_acq", None)
+                    self._recovering_pgs.discard(pg_id)
+            # claim reported actor workers for restored actor entries
+            for aw in p.get("actor_workers", ()):
+                aid = aw.get("actor_id")
+                entry2 = self._actors.get(aid) if aid is not None else None
+                if entry2 is not None and \
+                        entry2.worker_id == aw["worker_id"] and \
+                        entry2.state != DEAD:
+                    # idempotent re-claim: a repeated re-registration (first
+                    # reply lost on the node side) must not disown workers
+                    # the previous attempt already reconciled
+                    entry2.address = aw["address"]
+                    entry2.node_id = node_id
+                    continue
+                if entry2 is None or entry2.state == DEAD or \
+                        aid not in self._recovering_actors:
+                    kill.append(aw["worker_id"])
+                    continue
+                entry2.state = ALIVE
+                entry2.node_id = node_id
+                entry2.worker_id = aw["worker_id"]
+                entry2.address = aw["address"]
+                self._actor_by_worker[aw["worker_id"]] = aid
+                if entry2.pg is None:
+                    self.cluster.acquire(node_id, entry2.resources)
+                self._recovering_actors.discard(aid)
+                self._persist_dirty = True
+        return {"session": self.session, "incarnation": self.incarnation,
+                "kill": kill}
 
     def _h_unregister_node(self, p, ctx):
         self._mark_node_dead(p["node_id"], "unregistered")
@@ -284,6 +495,7 @@ class Head:
         with self._lock:
             self._next_job += 1
             job = self._next_job
+            self._persist_dirty = True
         return {"job_id": job, "session": self.session,
                 "nodes": self._h_list_nodes(None, None)}
 
@@ -374,7 +586,8 @@ class Head:
         node = self._nodes[node_id]
         try:
             grant = self._node_clients.get(node.address).call(
-                "lease_worker", {"resources": resources})
+                "lease_worker", {"resources": resources,
+                                 "runtime_env": p.get("runtime_env")})
         except RpcError as e:
             self._release(node_id, resources)
             self._mark_node_dead(node_id, f"lease rpc failed: {e}")
@@ -390,13 +603,14 @@ class Head:
             return {"infeasible": True, "reason": grant["invalid"]}
         with self._lock:
             self._lease_counter += 1
-            lease_id = f"l{self._lease_counter}"
+            lease_id = f"l{self.incarnation}.{self._lease_counter}"
             self._leases[lease_id] = _LeaseEntry(
                 lease_id, node_id, grant["worker_id"], grant["worker_addr"],
                 resources, ctx.peer if ctx is not None else None)
         return {"lease_id": lease_id, "node_id": node_id,
                 "worker_id": grant["worker_id"],
                 "worker_addr": grant["worker_addr"],
+                "node_addr": node.address,
                 "shm_name": node.shm_name}
 
     def _pg_lease(self, p, pg_id: bytes, ctx=None):
@@ -431,7 +645,8 @@ class Head:
             return {"retry": True}
         try:
             grant = self._node_clients.get(node.address).call(
-                "lease_worker", {"resources": resources})
+                "lease_worker", {"resources": resources,
+                                 "runtime_env": p.get("runtime_env")})
         except RpcError as e:
             self._bundle_return(pg_id, idx, resources)
             self._mark_node_dead(node_id, f"lease rpc failed: {e}")
@@ -447,7 +662,7 @@ class Head:
             return {"infeasible": True, "reason": grant["invalid"]}
         with self._lock:
             self._lease_counter += 1
-            lease_id = f"l{self._lease_counter}"
+            lease_id = f"l{self.incarnation}.{self._lease_counter}"
             # resources recorded for bundle return, not cluster release
             self._leases[lease_id] = _LeaseEntry(
                 lease_id, node_id, grant["worker_id"], grant["worker_addr"],
@@ -456,6 +671,7 @@ class Head:
         return {"lease_id": lease_id, "node_id": node_id,
                 "worker_id": grant["worker_id"],
                 "worker_addr": grant["worker_addr"],
+                "node_addr": node.address,
                 "shm_name": node.shm_name}
 
     def _bundle_return(self, pg_id: bytes, idx: int,
@@ -507,6 +723,7 @@ class Head:
             actor_id, p["spec_bytes"], p["max_restarts"],
             p["max_task_retries"], p.get("name_key", ""),
             p["resources"], p.get("owner_addr", ""), p.get("class_name", ""))
+        entry.runtime_env = p.get("runtime_env")
         if p.get("pg_id") is not None:
             # bundle reservations cover the cluster accounting; the node
             # lease still carries the physical shape (lease_resources) so
@@ -520,6 +737,8 @@ class Head:
                         f"named actor {entry.name_key!r} already exists")
                 self._named[entry.name_key] = actor_id
             self._actors[actor_id] = entry
+            self._persist_dirty = True
+        self._persist_kick.set()
         self._spawn_actor(entry)
         return True
 
@@ -543,7 +762,9 @@ class Head:
                     node = self._nodes[node_id]
                     try:
                         grant = self._node_clients.get(node.address).call(
-                            "lease_worker", {"resources": entry.resources})
+                            "lease_worker",
+                            {"resources": entry.resources,
+                             "runtime_env": entry.runtime_env})
                     except RpcError:
                         self._release(node_id, entry.resources)
                         self._mark_node_dead(node_id, "actor lease rpc failed")
@@ -552,6 +773,18 @@ class Head:
                         self._release(node_id, entry.resources)
                         time.sleep(0.05)
                         continue
+                    if isinstance(grant, dict) and "invalid" in grant:
+                        # unsatisfiable lease (bad TPU shape, runtime_env
+                        # materialization failure): surface as creation
+                        # failure, don't spin forever
+                        self._release(node_id, entry.resources)
+                        with self._lock:
+                            if entry.state != DEAD:
+                                entry.state = DEAD
+                                entry.reason = grant["invalid"]
+                                self._persist_dirty = True
+                        self._persist_kick.set()
+                        return
                     with self._lock:
                         if entry.state == DEAD:  # killed during the lease
                             self._release(node_id, entry.resources)
@@ -631,6 +864,8 @@ class Head:
                 return False
             entry.state = ALIVE
             entry.address = p["address"]
+            self._persist_dirty = True
+        self._persist_kick.set()
         return True
 
     def _h_actor_failed(self, p, ctx):
@@ -645,6 +880,8 @@ class Head:
                 return False
             entry.state = DEAD
             entry.reason = p.get("reason", "creation failed")
+            self._persist_dirty = True
+            self._persist_kick.set()
             node = self._nodes.get(entry.node_id) if entry.node_id else None
             worker_id = entry.worker_id
             self._cleanup_actor_placement(entry)
@@ -701,6 +938,7 @@ class Head:
                 return False
             if p.get("no_restart", True):
                 entry.restarts_left = 0
+                self._persist_dirty = True
             node = self._nodes.get(entry.node_id) if entry.node_id else None
             worker_id = entry.worker_id
             if worker_id is None and entry.state in (PENDING, RESTARTING) \
@@ -709,6 +947,7 @@ class Head:
                 # loop aborts instead of starting a killed actor
                 entry.state = DEAD
                 entry.reason = "killed before start"
+                self._recovering_actors.discard(actor_id)
         if node is not None and worker_id is not None:
             try:
                 self._node_clients.get(node.address).call(
@@ -748,6 +987,8 @@ class Head:
                 entry.state = DEAD
                 entry.reason = reason
                 restart = False
+            self._persist_dirty = True
+        self._persist_kick.set()
         if restart:
             self._spawn_actor(entry)
 
@@ -798,6 +1039,7 @@ class Head:
             self._pgs[p["pg_id"]] = {
                 "bundles": p["bundles"], "nodes": None, "state": "PENDING",
                 "strategy": p["strategy"], "name": p.get("name", "")}
+            self._persist_dirty = True
         self._try_schedule_pgs()
         return True
 
@@ -814,14 +1056,25 @@ class Head:
                 if nodes is not None:
                     pg["nodes"] = nodes
                     pg["state"] = "CREATED"
+                    self._persist_dirty = True
+                    self._persist_kick.set()
 
     def _h_remove_pg(self, p, ctx):
         with self._lock:
             pg = self._pgs.pop(p["pg_id"], None)
             if pg is None:
                 return False
+            self._persist_dirty = True
+            recovering = p["pg_id"] in self._recovering_pgs
+            self._recovering_pgs.discard(p["pg_id"])
             if pg["state"] == "CREATED":
-                for node_id, bundle in zip(pg["nodes"], pg["bundles"]):
+                acq = pg.pop("_acq", None)
+                for idx, (node_id, bundle) in enumerate(
+                        zip(pg["nodes"], pg["bundles"])):
+                    if recovering and (acq is None or idx not in acq):
+                        # post-restart: this bundle was never re-acquired —
+                        # releasing it would overcommit the node
+                        continue
                     if node_id in self._nodes and self._nodes[node_id].alive:
                         self.cluster.release(node_id, bundle)
         self._try_schedule_pgs()
@@ -943,7 +1196,7 @@ class Head:
         self._stopped.set()
         if self._persist_path:
             try:
-                self._save_kv()
+                self._save_snapshot()
             except Exception:  # noqa: BLE001
                 pass
         self.server.stop()

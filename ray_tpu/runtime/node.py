@@ -31,9 +31,10 @@ from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
 
 class _WorkerEntry:
     __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id",
-                 "chips")
+                 "chips", "env_key")
 
-    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen,
+                 env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
         self.address: Optional[str] = None
@@ -41,6 +42,10 @@ class _WorkerEntry:
         self.state = "starting"  # starting | idle | leased | actor | dead
         self.actor_id: Optional[bytes] = None
         self.chips: Optional[list] = None  # TPU chip ids owned (single-use)
+        # runtime-env signature this worker was spawned under; workers only
+        # serve leases of their own environment (reference: WorkerPool keys
+        # workers by runtime_env hash, worker_pool.h:224)
+        self.env_key = env_key
 
 
 class NodeDaemon:
@@ -74,7 +79,8 @@ class NodeDaemon:
             cfg.object_store_max_objects)
         self._lock = threading.RLock()
         self._workers: Dict[bytes, _WorkerEntry] = {}
-        self._idle: List[bytes] = []
+        # env_key -> FIFO of idle worker ids ('' = default environment)
+        self._idle: Dict[str, List[bytes]] = {}
         self._spawn_reserved = 0  # in-flight spawns counted against the cap
         self._clients = ClientPool(name="node")
         self._stopped = threading.Event()
@@ -92,18 +98,76 @@ class NodeDaemon:
             "shutdown": self._h_shutdown,
         }, host=host, port=port, max_workers=32, name="node")
         self.address = self.server.address
-        # register with head
-        self._clients.get(head_addr).call_retrying("register_node", {
-            "node_id": self.node_id, "address": self.address,
-            "shm_name": self.shm_name, "resources": self.resources,
-        })
+        # worker deaths the head hasn't acknowledged yet (it may be down
+        # mid-restart); flushed by the head-watch loop after reconnect
+        self._dead_unreported: List[dict] = []
+        self._head_incarnation: Optional[str] = None
+        self._register_with_head(retrying=True)
+        # watch the head for restarts: a new incarnation means fresh head
+        # tables — re-register and hand over our still-running actor
+        # workers for reconciliation (reference: raylet reconnect to a
+        # restarted GCS, gcs_server/gcs_init_data.h rebuild path)
+        threading.Thread(target=self._head_watch_loop, daemon=True,
+                         name="node-head-watch").start()
         for _ in range(cfg.worker_pool_prestart):
             self._spawn_worker()
+
+    # ------------------------------------------------------ head liveness
+
+    def _register_with_head(self, retrying: bool = False) -> None:
+        with self._lock:
+            actor_workers = [
+                {"worker_id": w.worker_id, "actor_id": w.actor_id,
+                 "address": w.address}
+                for w in self._workers.values()
+                if w.state == "actor" and w.actor_id is not None
+                and w.address is not None]
+        payload = {
+            "node_id": self.node_id, "address": self.address,
+            "shm_name": self.shm_name, "resources": self.resources,
+            "actor_workers": actor_workers,
+        }
+        client = self._clients.get(self.head_addr)
+        reply = (client.call_retrying if retrying else client.call)(
+            "register_node", payload)
+        self._head_incarnation = (reply or {}).get("incarnation")
+        # workers whose actors the (restarted) head disowned: reap them so
+        # the pool doesn't leak orphans serving nobody
+        for wid in (reply or {}).get("kill", ()):
+            self._h_kill_worker({"worker_id": wid}, None)
+
+    def _head_watch_loop(self) -> None:
+        period = config_mod.GlobalConfig.node_head_watch_period_s
+        client = self._clients.get(self.head_addr)
+        while not self._stopped.wait(period):
+            try:
+                pong = client.call("ping", timeout=max(2.0, period * 4))
+            except RpcError:
+                continue  # head down/restarting: keep polling
+            inc = pong.get("incarnation") if isinstance(pong, dict) else None
+            try:
+                if inc is not None and inc != self._head_incarnation:
+                    self._register_with_head()
+                self._flush_dead_reports()
+            except RpcError:
+                continue
+
+    def _flush_dead_reports(self) -> None:
+        with self._lock:
+            pending, self._dead_unreported = self._dead_unreported, []
+        for rep in pending:
+            try:
+                self._clients.get(self.head_addr).call("worker_died", rep)
+            except RpcError:
+                with self._lock:
+                    self._dead_unreported.append(rep)
 
     # ------------------------------------------------------------ worker pool
 
     def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
-                      chips: Optional[list] = None) -> _WorkerEntry:
+                      chips: Optional[list] = None,
+                      env_key: str = "",
+                      cwd: Optional[str] = None) -> _WorkerEntry:
         worker_id = WorkerID.from_random().binary()
         from ray_tpu.runtime.spawn import child_env
         extra = {"RTPU_SESSION": self.session}
@@ -113,8 +177,8 @@ class NodeDaemon:
         cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main",
                self.address, self.head_addr, self.shm_name,
                worker_id.hex(), config_mod.GlobalConfig.to_json()]
-        proc = subprocess.Popen(cmd, env=env)
-        entry = _WorkerEntry(worker_id, proc)
+        proc = subprocess.Popen(cmd, env=env, cwd=cwd)
+        entry = _WorkerEntry(worker_id, proc, env_key=env_key)
         entry.chips = chips
         with self._lock:
             self._workers[worker_id] = entry
@@ -131,21 +195,23 @@ class NodeDaemon:
             prev_state = entry.state
             entry.state = "dead"
             self._workers.pop(entry.worker_id, None)
-            if entry.worker_id in self._idle:
-                self._idle.remove(entry.worker_id)
+            pool = self._idle.get(entry.env_key, [])
+            if entry.worker_id in pool:
+                pool.remove(entry.worker_id)
             if self.chips is not None:
                 self.chips.release(entry.worker_id)
         entry.ready.set()
         if self._stopped.is_set() or prev_state == "stopping":
             return
+        report = {"worker_id": entry.worker_id, "node_id": self.node_id,
+                  "reason": f"exit code {rc}"}
         try:
-            self._clients.get(self.head_addr).call("worker_died", {
-                "worker_id": entry.worker_id,
-                "node_id": self.node_id,
-                "reason": f"exit code {rc}",
-            })
+            self._clients.get(self.head_addr).call("worker_died", report)
         except RpcError:
-            pass
+            # head unreachable (likely restarting): queue the report so an
+            # actor death during head downtime still triggers its restart
+            with self._lock:
+                self._dead_unreported.append(report)
 
     def _h_worker_ready(self, p, ctx):
         worker_id = p["worker_id"]
@@ -158,7 +224,7 @@ class NodeDaemon:
             # for a CPU task would strand its chips
             if entry.state == "starting" and entry.chips is None:
                 entry.state = "idle"
-                self._idle.append(worker_id)
+                self._idle.setdefault(entry.env_key, []).append(worker_id)
         entry.ready.set()
         return True
 
@@ -172,12 +238,22 @@ class NodeDaemon:
         chips and chip workers never return to the generic pool.
         """
         cfg = config_mod.GlobalConfig
+        renv = p.get("runtime_env") or None
+        try:
+            env_key, env_extra, cwd = self._prepare_runtime_env(renv)
+        except Exception as e:  # noqa: BLE001 — missing package, bad zip…
+            # structured reply, not a typed exception: a raised error would
+            # bypass the head's RpcError handling and leak the resources it
+            # acquired for this lease (same contract as invalid TPU shapes)
+            return {"invalid": f"runtime_env setup failed: {e}"}
         n_tpu = int(p.get("resources", {}).get("TPU", 0) or 0)
         if n_tpu > 0 and self.chips is not None:
-            return self._lease_tpu_worker(n_tpu, cfg)
+            return self._lease_tpu_worker(n_tpu, cfg, env_extra=env_extra,
+                                          cwd=cwd)
         with self._lock:
-            while self._idle:
-                wid = self._idle.pop(0)
+            pool = self._idle.setdefault(env_key, [])
+            while pool:
+                wid = pool.pop(0)
                 entry = self._workers.get(wid)
                 if entry is not None and entry.state == "idle":
                     entry.state = "leased"
@@ -188,7 +264,8 @@ class NodeDaemon:
                 return None
             self._spawn_reserved += 1
         try:
-            entry = self._spawn_worker()
+            entry = self._spawn_worker(env_extra=env_extra, env_key=env_key,
+                                       cwd=cwd)
         finally:
             with self._lock:
                 self._spawn_reserved -= 1
@@ -196,14 +273,36 @@ class NodeDaemon:
             return None
         with self._lock:
             if entry.state in ("starting", "idle"):
-                if entry.worker_id in self._idle:
-                    self._idle.remove(entry.worker_id)
+                pool = self._idle.get(entry.env_key, [])
+                if entry.worker_id in pool:
+                    pool.remove(entry.worker_id)
                 entry.state = "leased"
                 return {"worker_id": entry.worker_id,
                         "worker_addr": entry.address}
         return None
 
-    def _lease_tpu_worker(self, n_tpu: int, cfg):
+    def _prepare_runtime_env(self, renv):
+        """(env_key, spawn-env additions, cwd) for a lease's runtime env.
+        Materializes the working_dir package into the node cache on first
+        use (reference: per-node runtime-env agent)."""
+        from ray_tpu.runtime import runtime_env as rtenv
+        if not renv:
+            return "", None, None
+        env_key = rtenv.descriptor_key(renv)
+        wd_path = None
+        uri = renv.get("working_dir_uri")
+        if uri:
+            cache_root = os.path.join(
+                config_mod.GlobalConfig.session_dir,
+                f"rtenv_{self.session[:8]}")
+            os.makedirs(cache_root, exist_ok=True)
+            wd_path = rtenv.materialize(
+                cache_root, uri,
+                lambda k: self._clients.get(self.head_addr).call(
+                    "kv_get", {"key": k}))
+        return env_key, rtenv.worker_env(renv, wd_path), wd_path
+
+    def _lease_tpu_worker(self, n_tpu: int, cfg, env_extra=None, cwd=None):
         from ray_tpu.accelerators.tpu import TPUAcceleratorManager
         try:
             TPUAcceleratorManager.validate_chip_request(n_tpu)
@@ -222,7 +321,9 @@ class NodeDaemon:
         entry = None
         try:
             env = TPUAcceleratorManager.visibility_env(chips)
-            entry = self._spawn_worker(env_extra=env, chips=chips)
+            if env_extra:
+                env = {**env_extra, **env}
+            entry = self._spawn_worker(env_extra=env, chips=chips, cwd=cwd)
         finally:
             with self._lock:
                 self._spawn_reserved -= 1
@@ -239,8 +340,9 @@ class NodeDaemon:
             return None
         with self._lock:
             if entry.state in ("starting", "idle"):
-                if entry.worker_id in self._idle:
-                    self._idle.remove(entry.worker_id)
+                pool = self._idle.get(entry.env_key, [])
+                if entry.worker_id in pool:
+                    pool.remove(entry.worker_id)
                 entry.state = "leased"
                 return {"worker_id": entry.worker_id,
                         "worker_addr": entry.address}
@@ -258,8 +360,9 @@ class NodeDaemon:
                 proc = entry.proc
             else:
                 entry.state = "idle"
-                if entry.worker_id not in self._idle:
-                    self._idle.append(entry.worker_id)
+                pool = self._idle.setdefault(entry.env_key, [])
+                if entry.worker_id not in pool:
+                    pool.append(entry.worker_id)
                 proc = None
         if proc is not None:
             try:

@@ -52,6 +52,12 @@ class Executor:
         # here a plain asyncio loop thread + semaphore)
         self._aio_loop = None
         self._aio_sem = None
+        # packages async-actor replies (serialize + shm copy + socket write)
+        # off the event-loop thread so one large result can't stall every
+        # interleaved coroutine
+        from concurrent.futures import ThreadPoolExecutor
+        self._reply_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="reply")
         self._threads: List[threading.Thread] = []
         # concurrency groups (reference: ConcurrencyGroupManager,
         # core_worker/transport/concurrency_group_manager.h): each group
@@ -259,7 +265,6 @@ class Executor:
 
     def _reply_ok(self, payload: dict, ctx, result: Any,
                   t_start: float) -> None:
-        self._record_span(payload, t_start, ok=True)
         num_returns = payload["num_returns"]
         if num_returns == 1:
             values = [result]
@@ -270,6 +275,7 @@ class Executor:
                     f"{type(result)}"), t_start)
                 return
             values = list(result)
+        self._record_span(payload, t_start, ok=True)
         cfg = config_mod.GlobalConfig
         results = []
         contained = []
@@ -394,7 +400,7 @@ class Executor:
 
         fut = asyncio.run_coroutine_threadsafe(run(), self._aio_loop)
 
-        def done(f):
+        def package(f):
             try:
                 result = f.result()
             except BaseException as e:  # noqa: BLE001
@@ -407,7 +413,11 @@ class Executor:
                 return  # replied inside run() with the true item count
             self._reply_ok(payload, ctx, result, t_start)
 
-        fut.add_done_callback(done)
+        # done-callbacks run ON the loop thread; serializing a large result
+        # there would stall every interleaved coroutine, so hand reply
+        # packaging to the reply pool and keep the loop free
+        fut.add_done_callback(
+            lambda f: self._reply_pool.submit(package, f))
 
 
 def pickle_loads(data: bytes):
@@ -418,6 +428,13 @@ def pickle_loads(data: bytes):
 def main() -> None:
     node_addr, head_addr, shm_name, worker_hex, cfg_json = sys.argv[1:6]
     config_mod.GlobalConfig.apply(json.loads(cfg_json))
+
+    # runtime_env working_dir: the node daemon spawned us with cwd set to
+    # the materialized package; make its modules importable like the
+    # reference does (runtime_env/working_dir.py adds it to sys.path)
+    _wd = os.environ.get("RTPU_WORKING_DIR")
+    if _wd:
+        sys.path.insert(0, _wd)
 
     # Die with the node daemon (reference: raylet owns worker lifetimes —
     # node death must kill its workers or "node failure" tests lie).

@@ -1,0 +1,140 @@
+"""Per-task/actor runtime environments (reference:
+python/ray/_private/runtime_env/ — env_vars + working_dir scope;
+unsupported keys fail fast instead of being silently dropped)."""
+
+import os
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.runtime import runtime_env as rtenv
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_unsupported_keys_raise():
+    with pytest.raises(NotImplementedError):
+        rtenv.validate({"pip": ["requests"]})
+    with pytest.raises(NotImplementedError):
+        rtenv.validate({"conda": "env.yml"})
+    with pytest.raises(ValueError):
+        rtenv.validate({"env_vars": {"A": 1}})  # non-str value
+    assert rtenv.validate(None) is None
+    assert rtenv.validate({}) is None
+    assert rtenv.validate({"env_vars": {"A": "1"}}) == {"env_vars": {"A": "1"}}
+
+
+def test_decorator_rejects_unsupported_env():
+    with pytest.raises(NotImplementedError):
+        @rt.remote(runtime_env={"pip": ["x"]})
+        def f():
+            return 1
+
+
+def test_packaging_deterministic(tmp_path):
+    d = tmp_path / "wd"
+    d.mkdir()
+    (d / "a.txt").write_text("hello")
+    (d / "sub").mkdir()
+    (d / "sub" / "b.txt").write_text("world")
+    uri1, blob1 = rtenv.package_working_dir(str(d))
+    uri2, blob2 = rtenv.package_working_dir(str(d))
+    assert uri1 == uri2 and blob1 == blob2
+    (d / "a.txt").write_text("changed")
+    uri3, _ = rtenv.package_working_dir(str(d))
+    assert uri3 != uri1
+
+
+# -------------------------------------------------------------- local mode
+
+
+def test_local_mode_env_vars(rtpu_local):
+    @rtpu_local.remote(runtime_env={"env_vars": {"LOCAL_ENV_X": "on"}})
+    def read():
+        return os.environ.get("LOCAL_ENV_X")
+
+    assert rtpu_local.get(read.remote(), timeout=30) == "on"
+    assert os.environ.get("LOCAL_ENV_X") is None  # restored after the call
+
+
+def test_local_mode_working_dir_rejected(rtpu_local, tmp_path):
+    @rtpu_local.remote(runtime_env={"working_dir": str(tmp_path)})
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        rtpu_local.get(f.remote(), timeout=30)
+
+
+# ------------------------------------------------------------ cluster mode
+
+
+@pytest.fixture(scope="module")
+def env_rt():
+    rt.init(num_cpus=4, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024,
+        "worker_pool_max": 8,
+    })
+    yield rt
+    rt.shutdown()
+
+
+def test_task_sees_env_vars(env_rt):
+    @rt.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "42"}})
+    def read():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    @rt.remote
+    def read_plain():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert rt.get(read.remote(), timeout=60) == "42"
+    # a default-environment worker must NOT inherit the env
+    assert rt.get(read_plain.remote(), timeout=60) is None
+
+
+def test_distinct_envs_get_distinct_workers(env_rt):
+    @rt.remote(runtime_env={"env_vars": {"WHO": "alpha"}})
+    def who_a():
+        return os.environ["WHO"], os.getpid()
+
+    @rt.remote(runtime_env={"env_vars": {"WHO": "beta"}})
+    def who_b():
+        return os.environ["WHO"], os.getpid()
+
+    (va, pa), (vb, pb) = rt.get([who_a.remote(), who_b.remote()], timeout=60)
+    assert va == "alpha" and vb == "beta"
+    assert pa != pb
+
+
+def test_working_dir_ships_files_and_modules(env_rt, tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-123")
+    (wd / "helper_mod_rtenv.py").write_text(
+        "def magic():\n    return 777\n")
+
+    @rt.remote(runtime_env={"working_dir": str(wd)})
+    def use():
+        import helper_mod_rtenv
+        with open("data.txt") as f:
+            data = f.read()
+        return data, helper_mod_rtenv.magic(), os.getcwd()
+
+    data, magic, cwd = rt.get(use.remote(), timeout=90)
+    assert data == "payload-123"
+    assert magic == 777
+    assert str(wd) not in cwd  # ran from the node cache, not the source dir
+
+
+def test_actor_runtime_env(env_rt):
+    @rt.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class E:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    e = E.remote()
+    assert rt.get(e.read.remote(), timeout=60) == "yes"
+
+
