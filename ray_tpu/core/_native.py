@@ -195,9 +195,12 @@ class ShmStore:
         (reference equivalent: plasma buffers keep a client pin until the
         PlasmaBuffer is destructed).
         """
+        h = self._h
+        if not h:
+            return None
         ptr = ctypes.c_void_p()
         size = ctypes.c_uint64()
-        rc = self._lib.rtpu_store_get(self._h, object_id, ctypes.byref(ptr),
+        rc = self._lib.rtpu_store_get(h, object_id, ctypes.byref(ptr),
                                       ctypes.byref(size))
         if rc in (ERR_NOT_FOUND, ERR_NOT_SEALED):
             return None
@@ -212,17 +215,27 @@ class ShmStore:
         return memoryview(arr).cast("B")
 
     def release(self, object_id: bytes) -> None:
-        self._lib.rtpu_store_release(self._h, object_id)
+        h = self._h  # snapshot: background threads may race close()
+        if h:
+            self._lib.rtpu_store_release(h, object_id)
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(self._lib.rtpu_store_contains(self._h, object_id))
+        # Snapshot the handle: fetch/resolve threads poll contains() and can
+        # race shutdown's close(); a null handle must read as "absent", not
+        # a native-deref crash.
+        h = self._h
+        return bool(h) and bool(self._lib.rtpu_store_contains(h, object_id))
 
     def delete(self, object_id: bytes) -> bool:
-        return self._lib.rtpu_store_delete(self._h, object_id) == OK
+        h = self._h
+        return bool(h) and self._lib.rtpu_store_delete(h, object_id) == OK
 
     def stats(self) -> dict:
+        h = self._h
+        if not h:
+            return {}
         st = _StoreStats()
-        self._lib.rtpu_store_stats(self._h, ctypes.byref(st))
+        self._lib.rtpu_store_stats(h, ctypes.byref(st))
         return {f[0]: getattr(st, f[0]) for f in _StoreStats._fields_}
 
     def close(self) -> None:

@@ -25,6 +25,8 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "memory_store_threshold_bytes": (int, 100 * 1024, "objects <= this inline in the owner memory store; larger go to shm"),
     "object_transfer_chunk_bytes": (int, 5 * 1024**2, "chunk size for node-to-node object push"),
     "object_pull_retry_ms": (int, 200, "pull retry interval"),
+    "object_pull_chunk_inflight": (int, 8, "pipelined chunk requests per pull (reference: PushManager max_chunks_in_flight)"),
+    "object_pull_max_concurrent": (int, 4, "concurrent large-object pulls per process (reference: PullManager admission control)"),
     # --- rpc ---
     "rpc_connect_timeout_s": (float, 10.0, "client connect timeout"),
     "rpc_call_timeout_s": (float, 60.0, "default unary call deadline"),

@@ -23,18 +23,24 @@ from ray_tpu.core.task_spec import ActorCreationSpec, TaskArg, TaskSpec
 from ray_tpu.exceptions import (ActorDiedError, TaskCancelledError, TaskError)
 
 # Local mode runs tasks as threads in ONE process, so env_vars are applied
-# to os.environ around the call. The lock covers only the mutate/restore
-# steps (holding it across user code would deadlock a nested env'd
-# ray.get()); concurrent env'd tasks can therefore observe each other's
-# variables — a documented dev-mode tradeoff, since true isolation needs
-# the cluster runtime's per-env worker processes.
+# to os.environ around the call. Per-key depth counting makes overlapping
+# env'd tasks composable: the FIRST task to touch a key records the
+# process-original value, and only the LAST task to leave restores it —
+# naive save/restore pairs leak one task's value into the process forever
+# under interleaved exits. While tasks overlap, last-writer-wins is
+# visible across threads (a documented dev-mode tradeoff; true isolation
+# needs the cluster runtime's per-env worker processes). The lock covers
+# only mutate/restore, never user code (holding it across user code would
+# deadlock a nested env'd ray.get()).
 _env_lock = threading.Lock()
+_env_depth: Dict[str, int] = {}
+_env_original: Dict[str, Optional[str]] = {}
 
 
 class _applied_runtime_env:
     def __init__(self, renv):
         self.renv = renv or None
-        self._saved = None
+        self._keys = None
 
     def __enter__(self):
         if self.renv is None:
@@ -47,19 +53,27 @@ class _applied_runtime_env:
         env_vars = self.renv.get("env_vars") or {}
         if env_vars:
             with _env_lock:
-                self._saved = {k: os.environ.get(k) for k in env_vars}
-                os.environ.update(env_vars)
+                for k, v in env_vars.items():
+                    if _env_depth.get(k, 0) == 0:
+                        _env_original[k] = os.environ.get(k)
+                    _env_depth[k] = _env_depth.get(k, 0) + 1
+                    os.environ[k] = v
+            self._keys = list(env_vars)
         return self
 
     def __exit__(self, *exc):
-        if self._saved is not None:
+        if self._keys is not None:
             with _env_lock:
-                for k, v in self._saved.items():
-                    if v is None:
-                        os.environ.pop(k, None)
-                    else:
-                        os.environ[k] = v
-            self._saved = None
+                for k in self._keys:
+                    _env_depth[k] = _env_depth.get(k, 1) - 1
+                    if _env_depth[k] <= 0:
+                        _env_depth.pop(k, None)
+                        orig = _env_original.pop(k, None)
+                        if orig is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = orig
+            self._keys = None
         return False
 
 

@@ -531,6 +531,7 @@ class ClusterBackend:
         # owner service: every process is reachable for object resolution
         self.server = RpcServer({
             "get_object": self.object_plane.handle_get_object,
+            "add_location": self.object_plane.handle_add_location,
             "add_borrower": self.object_plane.handle_add_borrower,
             "remove_borrower": self.object_plane.handle_remove_borrower,
             "stream_item": self._h_stream_item,

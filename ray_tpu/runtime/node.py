@@ -31,7 +31,7 @@ from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
 
 class _WorkerEntry:
     __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id",
-                 "chips", "env_key")
+                 "chips", "env_key", "idle_since")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
                  env_key: str = ""):
@@ -46,6 +46,7 @@ class _WorkerEntry:
         # serve leases of their own environment (reference: WorkerPool keys
         # workers by runtime_env hash, worker_pool.h:224)
         self.env_key = env_key
+        self.idle_since: Optional[float] = None
 
 
 class NodeDaemon:
@@ -91,6 +92,8 @@ class NodeDaemon:
             "kill_worker": self._h_kill_worker,
             "worker_ready": self._h_worker_ready,
             "read_object": self._h_read_object,
+            "object_info": self._h_object_info,
+            "read_chunk": self._h_read_chunk,
             "delete_object": self._h_delete_object,
             "store_stats": lambda p, c: self.store.stats(),
             "list_workers": self._h_list_workers,
@@ -109,6 +112,10 @@ class NodeDaemon:
         # restarted GCS, gcs_server/gcs_init_data.h rebuild path)
         threading.Thread(target=self._head_watch_loop, daemon=True,
                          name="node-head-watch").start()
+        # reap idle workers past worker_idle_timeout_s (reference:
+        # WorkerPool idle eviction, worker_pool.h:224)
+        threading.Thread(target=self._idle_reap_loop, daemon=True,
+                         name="node-idle-reap").start()
         for _ in range(cfg.worker_pool_prestart):
             self._spawn_worker()
 
@@ -163,6 +170,56 @@ class NodeDaemon:
                     self._dead_unreported.append(rep)
 
     # ------------------------------------------------------------ worker pool
+
+    def _retire_locked(self, entry: "_WorkerEntry"):
+        """Remove an idle worker from the pool books (caller holds the
+        lock) and return its proc for termination outside the lock. The
+        waiter thread's cleanup is idempotent against this removal."""
+        entry.state = "stopping"
+        self._workers.pop(entry.worker_id, None)
+        pool = self._idle.get(entry.env_key, [])
+        if entry.worker_id in pool:
+            pool.remove(entry.worker_id)
+        return entry.proc
+
+    def _evict_one_idle_locked(self, exclude_env: str):
+        """Free a pool slot by retiring the oldest idle worker of some
+        OTHER environment (caller holds the lock). Without this, a pool
+        full of idle default-env workers starves every runtime_env lease
+        forever (the cap counts them but nothing reclaims them)."""
+        for env_key, pool in self._idle.items():
+            if env_key == exclude_env:
+                continue
+            while pool:
+                entry = self._workers.get(pool[0])
+                if entry is None or entry.state != "idle":
+                    pool.pop(0)
+                    continue
+                return self._retire_locked(entry)
+        return None
+
+    def _idle_reap_loop(self) -> None:
+        timeout_s = config_mod.GlobalConfig.worker_idle_timeout_s
+        period = min(30.0, max(1.0, timeout_s / 4))
+        while not self._stopped.wait(period):
+            now = time.monotonic()
+            procs = []
+            with self._lock:
+                for pool in self._idle.values():
+                    for wid in list(pool):
+                        entry = self._workers.get(wid)
+                        if entry is None:
+                            pool.remove(wid)
+                            continue
+                        if entry.state == "idle" and \
+                                entry.idle_since is not None and \
+                                now - entry.idle_since > timeout_s:
+                            procs.append(self._retire_locked(entry))
+            for proc in procs:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
 
     def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
                       chips: Optional[list] = None,
@@ -224,6 +281,7 @@ class NodeDaemon:
             # for a CPU task would strand its chips
             if entry.state == "starting" and entry.chips is None:
                 entry.state = "idle"
+                entry.idle_since = time.monotonic()
                 self._idle.setdefault(entry.env_key, []).append(worker_id)
         entry.ready.set()
         return True
@@ -241,6 +299,11 @@ class NodeDaemon:
         renv = p.get("runtime_env") or None
         try:
             env_key, env_extra, cwd = self._prepare_runtime_env(renv)
+        except RpcError:
+            # transient: the head (KV holding the package) is unreachable —
+            # report "busy" so the lease is retried, never a permanent
+            # failure that kills the task/actor
+            return None
         except Exception as e:  # noqa: BLE001 — missing package, bad zip…
             # structured reply, not a typed exception: a raised error would
             # bypass the head's RpcError handling and leak the resources it
@@ -260,9 +323,17 @@ class NodeDaemon:
                     return {"worker_id": wid, "worker_addr": entry.address}
             # count in-flight spawns too — concurrent lease RPCs must not
             # overshoot the pool cap between check and spawn
+            evict_proc = None
             if len(self._workers) + self._spawn_reserved >= cfg.worker_pool_max:
-                return None
+                evict_proc = self._evict_one_idle_locked(env_key)
+                if evict_proc is None:
+                    return None  # pool genuinely busy: retry later
             self._spawn_reserved += 1
+        if evict_proc is not None:
+            try:
+                evict_proc.terminate()
+            except OSError:
+                pass
         try:
             entry = self._spawn_worker(env_extra=env_extra, env_key=env_key,
                                        cwd=cwd)
@@ -298,7 +369,7 @@ class NodeDaemon:
             os.makedirs(cache_root, exist_ok=True)
             wd_path = rtenv.materialize(
                 cache_root, uri,
-                lambda k: self._clients.get(self.head_addr).call(
+                lambda k: self._clients.get(self.head_addr).call_retrying(
                     "kv_get", {"key": k}))
         return env_key, rtenv.worker_env(renv, wd_path), wd_path
 
@@ -360,6 +431,7 @@ class NodeDaemon:
                 proc = entry.proc
             else:
                 entry.state = "idle"
+                entry.idle_since = time.monotonic()
                 pool = self._idle.setdefault(entry.env_key, [])
                 if entry.worker_id not in pool:
                     pool.append(entry.worker_id)
@@ -402,7 +474,9 @@ class NodeDaemon:
     # ----------------------------------------------------------- object plane
 
     def _h_read_object(self, p, ctx):
-        """Serve an object's bytes to a remote node (pull path); falls
+        """Serve an object's bytes in ONE frame (small objects only — the
+        pull path switches to object_info/read_chunk above the chunk size;
+        reference: ObjectManager::Push chunking, push_manager.h:30). Falls
         back to the node's spill directory for disk-overflowed objects."""
         view = self.store.get(p["object_id"])
         if view is None:
@@ -411,6 +485,40 @@ class NodeDaemon:
             return bytes(view)
         finally:
             self.store.release(p["object_id"])
+
+    def _h_object_info(self, p, ctx):
+        """Size probe for the chunked pull path (None = not here)."""
+        view = self.store.get(p["object_id"])
+        if view is not None:
+            try:
+                return {"size": len(view)}
+            finally:
+                self.store.release(p["object_id"])
+        try:
+            return {"size": os.path.getsize(
+                self._spill_path(p["object_id"])), "spilled": True}
+        except OSError:
+            return None
+
+    def _h_read_chunk(self, p, ctx):
+        """One chunk of a sealed (or spilled) object. Each chunk is an
+        independent request, so many pipeline concurrently over the
+        connection and a multi-GiB object never occupies a single frame
+        or a matching-size contiguous reply buffer (reference: 64KiB-5MiB
+        chunk streaming, object_manager.h / ObjectBufferPool)."""
+        off, ln = p["offset"], p["length"]
+        view = self.store.get(p["object_id"])
+        if view is not None:
+            try:
+                return bytes(view[off:off + ln])
+            finally:
+                self.store.release(p["object_id"])
+        try:
+            with open(self._spill_path(p["object_id"]), "rb") as f:
+                f.seek(off)
+                return f.read(ln)
+        except OSError:
+            return None
 
     def _spill_path(self, oid: bytes) -> str:
         from ray_tpu.core.config import GlobalConfig

@@ -62,9 +62,18 @@ class ObjectPlane:
         self.node_addrs = dict(node_addrs)     # node_id -> daemon address
         self.node_shm = dict(node_shm)         # node_id -> shm name
         self.locations: Dict[ObjectID, str] = {}   # owned large obj -> node
+        # owned obj -> nodes holding secondary (cache) copies; borrowers
+        # report in after a pull so later pulls stripe chunks across every
+        # holder (reference: OwnershipBasedObjectDirectory location set)
+        self.secondary: Dict[ObjectID, set] = {}
         self.owner_addrs: Dict[bytes, str] = {}    # worker_id -> rpc address
         self._peers = ClientPool(name="objplane")
         self._fetching: Set[ObjectID] = set()
+        # admission control: bound concurrent large-object pulls so a burst
+        # of gets can't open unbounded chunk pipelines (reference:
+        # PullManager admission, pull_manager.h:53)
+        self._pull_sem = threading.BoundedSemaphore(
+            max(1, config_mod.GlobalConfig.object_pull_max_concurrent))
         self._lock = threading.Lock()
         # containment pins: owned object -> refs it contains (release on free)
         self._contained: Dict[ObjectID, list] = {}
@@ -237,7 +246,9 @@ class ObjectPlane:
                     return
                 try:
                     reply = self.owner_client(ref.owner_id()).call(
-                        "get_object", {"object_id": ref.id().binary()})
+                        "get_object",
+                        {"object_id": ref.id().binary(),
+                         "requester": self.worker.worker_id.binary()})
                     failures = 0
                 except (RpcError, ObjectLostError):
                     failures += 1
@@ -265,8 +276,9 @@ class ObjectPlane:
                     return
                 if "shm" in reply:
                     try:
-                        oneshot = self._pull_to_local(ref.id(),
-                                                      reply["shm"])
+                        oneshot = self._pull_to_local(
+                            ref.id(), reply["shm"],
+                            sources=reply.get("shm_all"))
                     except (RpcError, ObjectLostError) as e:
                         # holder node died mid-pull: surface the loss
                         # instead of killing this thread (a silent death
@@ -281,34 +293,177 @@ class ObjectPlane:
                         self.worker.memory_store.put(
                             ref.id(), serialization.deserialize(oneshot))
                         return
+                    self._notify_pulled(ref)
                     self.worker.memory_store.mark_in_shm(ref.id())
                     return
         finally:
             with self._lock:
                 self._fetching.discard(ref.id())
 
-    def _pull_to_local(self, object_id: ObjectID,
-                       node_id: str) -> Optional[bytes]:
-        """Fetch a sealed object from a remote node into the local arena
+    def _pull_to_local(self, object_id: ObjectID, node_id: str,
+                       sources: Optional[list] = None) -> Optional[bytes]:
+        """Fetch a sealed object from remote node(s) into the local arena
         (reference pull path: pull_manager.h:53 -> ObjectManager::Push).
 
+        Small objects (<= one chunk) ship in a single read_object frame;
+        larger objects stream as pipelined fixed-size chunks, striped
+        across every node holding a copy, so a multi-GiB object never
+        occupies one RPC frame and a broadcast fans out over all holders.
+
         The local copy is a *secondary* (cache) copy: the creator pin is
-        released right away so LRU eviction can reclaim it; the primary on
-        `node_id` stays pinned until the owner frees it. If the local
-        arena is too full to cache, the fetched bytes are RETURNED so the
-        caller can still serve the current read (no disk spill for
-        secondaries — see store_result_bytes)."""
-        if node_id == self.local_node_id or \
-                self.store.contains(object_id.binary()):
+        released right away so LRU eviction can reclaim it; the primary
+        stays pinned until the owner frees it. If the local arena is too
+        full to cache, the fetched bytes are RETURNED so the caller can
+        still serve the current read (no disk spill for secondaries — see
+        store_result_bytes)."""
+        key = object_id.binary()
+        if node_id == self.local_node_id or self.store.contains(key):
             return None
-        data = self.node_client(node_id).call_retrying(
-            "read_object", {"object_id": object_id.binary()})
-        if data is None:
-            raise ObjectLostError(object_id.hex(), f"gone from {node_id}")
-        self.store_result_bytes(object_id, data, pin=False)
-        if not self.store.contains(object_id.binary()):
-            return data  # cache miss (arena full): one-shot bytes
-        return None
+        srcs = [node_id] + [s for s in (sources or ())
+                            if s != node_id and s != self.local_node_id]
+        cfg = config_mod.GlobalConfig
+        chunk = max(64 * 1024, cfg.object_transfer_chunk_bytes)
+        # size probe from the first reachable holder
+        info = None
+        for i, src in enumerate(srcs):
+            try:
+                info = self.node_client(src).call(
+                    "object_info", {"object_id": key})
+            except (RpcError, ObjectLostError):
+                continue
+            if info is not None:
+                srcs = srcs[i:] + srcs[:i]
+                break
+        if info is None:
+            raise ObjectLostError(object_id.hex(), f"gone from {srcs}")
+        if info["size"] <= chunk:
+            data = self.node_client(srcs[0]).call_retrying(
+                "read_object", {"object_id": key})
+            if data is None:
+                raise ObjectLostError(object_id.hex(),
+                                      f"gone from {srcs[0]}")
+            self.store_result_bytes(object_id, data, pin=False)
+            if not self.store.contains(key):
+                return data  # cache miss (arena full): one-shot bytes
+            return None
+        with self._pull_sem:
+            return self._pull_chunked(object_id, info["size"], chunk, srcs)
+
+    def _pull_chunked(self, object_id: ObjectID, size: int, chunk: int,
+                      sources: list) -> Optional[bytes]:
+        cfg = config_mod.GlobalConfig
+        key = object_id.binary()
+        cached = False
+        try:
+            buf = self.store.create_object(key, size)
+            dest = memoryview(buf).cast("B")
+            cached = True
+        except ObjectExists:
+            # another local process is mid-pull of the same object: wait
+            # for its seal instead of double-fetching
+            deadline = time.monotonic() + cfg.rpc_call_timeout_s
+            while time.monotonic() < deadline:
+                if self.store.contains(key):
+                    return None
+                time.sleep(0.02)
+            raise ObjectLostError(object_id.hex(),
+                                  "concurrent local pull never sealed")
+        except ObjectStoreFull:
+            dest = memoryview(bytearray(size))
+        try:
+            self._fetch_chunks(object_id, size, chunk, sources, dest)
+        except BaseException:
+            if cached:
+                # unsealed + creator-pin release -> native reclaims the
+                # half-written allocation instead of leaking arena space
+                self.store.release(key)
+            raise
+        if cached:
+            self.store.seal(key)
+            self.store.release(key)  # secondary copy: LRU-evictable
+            return None
+        return bytes(dest)
+
+    def _fetch_chunks(self, object_id: ObjectID, size: int, chunk: int,
+                      sources: list, dest) -> None:
+        """Sliding-window chunk pipeline, striped round-robin across
+        holders (reference: PushManager max-chunks-in-flight windowing,
+        push_manager.h:30). A failing holder is dropped from rotation and
+        its chunks retried from the survivors."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+        cfg = config_mod.GlobalConfig
+        window = max(1, cfg.object_pull_chunk_inflight)
+        key = object_id.binary()
+        offsets = list(range(0, size, chunk))
+        sources = list(sources)
+        inflight: Dict[Any, tuple] = {}
+        next_i = 0
+
+        def issue(off: int, attempts: int = 0) -> None:
+            if not sources:
+                raise ObjectLostError(object_id.hex(),
+                                      "every holder lost mid-pull")
+            src = sources[(off // chunk) % len(sources)]
+            ln = min(chunk, size - off)
+            fut = self.node_client(src).call_async(
+                "read_chunk",
+                {"object_id": key, "offset": off, "length": ln})
+            inflight[fut] = (off, ln, src, attempts)
+
+        while next_i < len(offsets) and len(inflight) < window:
+            issue(offsets[next_i])
+            next_i += 1
+        while inflight:
+            done, _ = wait(list(inflight), return_when=FIRST_COMPLETED,
+                           timeout=cfg.rpc_call_timeout_s)
+            if not done:
+                # A holder's connection is up but its daemon stopped
+                # serving: call_async futures only fail on connection loss,
+                # so force-fail every client with in-flight chunks. Their
+                # futures resolve with RpcError and flow through the normal
+                # failover/retry path below (a healthy source's chunks get
+                # re-issued — rare and cheap vs hanging the pull, and the
+                # admission permit, forever).
+                for src in {v[2] for v in inflight.values()}:
+                    addr = self.node_addrs.get(src)
+                    if addr is not None:
+                        self._peers.invalidate(addr)
+                continue
+            for fut in done:
+                off, ln, src, attempts = inflight.pop(fut)
+                exc = fut.exception()
+                data = None if exc is not None else fut.result()
+                if exc is not None or data is None or len(data) != ln:
+                    if len(sources) > 1 and src in sources:
+                        sources.remove(src)  # failover to other holders
+                    elif attempts >= cfg.rpc_retry_max_attempts:
+                        raise ObjectLostError(
+                            object_id.hex(),
+                            f"chunk @{off} failed from {src}: "
+                            f"{exc or 'gone'}")
+                    else:
+                        time.sleep(cfg.object_pull_retry_ms / 1000.0)
+                    issue(off, attempts + 1)
+                    continue
+                dest[off:off + ln] = data
+                if next_i < len(offsets):
+                    issue(offsets[next_i])
+                    next_i += 1
+
+    def _notify_pulled(self, ref: ObjectRef) -> None:
+        """Report our freshly-cached copy to the owner's directory so
+        later pulls can stripe across us too."""
+        if ref.owner_id() == self.worker.worker_id:
+            with self._lock:
+                self.secondary.setdefault(ref.id(), set()).add(
+                    self.local_node_id)
+            return
+        try:
+            self.owner_client(ref.owner_id()).oneway(
+                "add_location", {"object_id": ref.id().binary(),
+                                 "node_id": self.local_node_id})
+        except Exception:  # noqa: BLE001 — advisory only
+            pass
 
     def get_from_store(self, ref: ObjectRef) -> Tuple[Any, bool]:
         """Blocking read of a sealed object; pulls cross-node if needed.
@@ -321,13 +476,20 @@ class ObjectPlane:
             node_id = self.locations.get(oid)
             if node_id is None:
                 reply = self.owner_client(ref.owner_id()).call(
-                    "get_object", {"object_id": oid.binary()})
+                    "get_object",
+                    {"object_id": oid.binary(),
+                     "requester": self.worker.worker_id.binary()})
                 if not reply or "shm" not in reply:
                     raise ObjectLostError(oid.hex(), "no longer in shm")
                 node_id = reply["shm"]
-            oneshot = self._pull_to_local(oid, node_id)
+                sources = reply.get("shm_all")
+            else:
+                with self._lock:
+                    sources = list(self.secondary.get(oid, ()))
+            oneshot = self._pull_to_local(oid, node_id, sources=sources)
             if oneshot is not None:
                 return serialization.deserialize(oneshot), False
+            self._notify_pulled(ref)
         # guard=True: each read holds its own pin, released when the last
         # zero-copy view derived from this get dies — NOT when the
         # ObjectRef dies. Freeing the ref must never let the arena reuse
@@ -358,15 +520,29 @@ class ObjectPlane:
             return {"pending": True}
         value, is_error, in_shm = entry
         if in_shm:
-            return {"shm": self.locations.get(oid, self.local_node_id)}
+            primary = self.locations.get(oid, self.local_node_id)
+            with self._lock:
+                alts = [n for n in self.secondary.get(oid, ())
+                        if n != primary]
+            return {"shm": primary, "shm_all": [primary] + alts}
         so = (serialization.serialize_error(value) if is_error
               else serialization.serialize(value))
         data = so.to_bytes()
-        # undo the transient serialize-time pins on nested refs; the
-        # requester registers its own borrows when it deserializes
+        requester = p.get("requester")
         for r in so.contained_refs:
+            # transfer-before-release: pre-register the requester as a
+            # borrower of refs we own so releasing our serialize-time pin
+            # can't race its registration (see worker_main._reply_ok)
+            if requester and r.owner_id() == self.worker.worker_id:
+                self.worker.refcounter.add_borrower(r.id(), requester)
             self.worker.refcounter.on_serialized_ref_done(r.id())
         return {"inline": data, "is_error": is_error}
+
+    def handle_add_location(self, p, ctx):
+        with self._lock:
+            self.secondary.setdefault(
+                ObjectID(p["object_id"]), set()).add(p["node_id"])
+        return True
 
     def handle_add_borrower(self, p, ctx):
         self.worker.refcounter.add_borrower(
@@ -389,10 +565,21 @@ class ObjectPlane:
         delete_pending in shm_store.cc)."""
         key = object_id.binary()
         node_id = self.locations.pop(object_id, None)
+        with self._lock:
+            secondaries = self.secondary.pop(object_id, set())
+        secondaries.discard(node_id)
         if node_id is not None:
             try:
                 self.node_client(node_id).call(
                     "delete_object", {"object_id": key}, timeout=5.0)
+            except (RpcError, ObjectLostError):
+                pass
+        for n in secondaries:
+            # cache copies are unpinned/LRU-evictable; eager delete just
+            # frees the arena sooner
+            try:
+                self.node_client(n).call(
+                    "delete_object", {"object_id": key}, timeout=2.0)
             except (RpcError, ObjectLostError):
                 pass
         with self._lock:

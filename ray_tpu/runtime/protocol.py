@@ -98,12 +98,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-#: Frames above this are treated as stream corruption. The cap must sit
-#: far above any legitimate frame (cross-node object pulls ship a whole
-#: object's bytes in one read_object reply, bounded by arena capacity) —
-#: its job is catching desynced headers, whose lengths are effectively
-#: random u64s: P(random < 1 TiB) = 2^40/2^64 ≈ 6e-8, so 1 TiB keeps
-#: nearly all the protection without ever rejecting real traffic.
+#: Frames above this are treated as stream corruption. Large objects move
+#: as pipelined read_chunk frames (object_transfer_chunk_bytes each), so
+#: legitimate frames stay small; the cap's job is catching desynced
+#: headers, whose lengths are effectively random u64s:
+#: P(random < 1 TiB) = 2^40/2^64 ≈ 6e-8, so 1 TiB keeps nearly all the
+#: protection without ever rejecting real traffic.
 _MAX_FRAME_BYTES = 1 << 40
 
 

@@ -139,8 +139,11 @@ def materialize(cache_root: str, uri: str,
         raise RuntimeError(
             f"working_dir package {uri} missing from the cluster KV "
             f"(head restarted without persistence?)")
-    tmp = dest + ".tmp"
+    # unique tmp per attempt: concurrent materializations of the same URI
+    # must not rmtree each other's half-extracted trees
     import shutil
+    import threading
+    tmp = f"{dest}.tmp{os.getpid()}_{threading.get_ident()}"
     shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp, exist_ok=True)
     with zipfile.ZipFile(io.BytesIO(blob)) as zf:

@@ -290,9 +290,20 @@ class Executor:
                 node = self.backend.object_plane.store_result_bytes(
                     oid, so.to_bytes())
                 results.append({"in_shm": node})
+        # Transfer-before-release (owner-side): refs WE own riding in this
+        # reply get the caller pre-registered as a borrower BEFORE the
+        # serialize-time pins drop. Without this, releasing the pin races
+        # the caller's add_borrower registration, and the loser's object is
+        # freed while the caller holds a live ref (observed: the LAST ref
+        # of a 20-ref list reply lost the race and get() hung on
+        # "pending"). add_borrower is set-based, so the caller's own later
+        # registration is idempotent (reference: reference_count.h borrower
+        # bookkeeping — returned refs are charged to the caller up front).
+        caller = payload.get("owner")
+        for r in contained:
+            if caller and r.owner_id() == self.worker.worker_id:
+                self.worker.refcounter.add_borrower(r.id(), caller)
         ctx.reply({"results": results})
-        # undo transient serialize-time pins on refs nested in results; the
-        # owner registers its own borrows when it deserializes the reply
         for r in contained:
             self.worker.refcounter.on_serialized_ref_done(r.id())
 
@@ -313,6 +324,11 @@ class Executor:
             # streamed items are meant to be consumed-and-dropped
             msg["in_shm"] = self.backend.object_plane.store_result_bytes(
                 oid, so.to_bytes())
+        caller = payload.get("owner")
+        for r in so.contained_refs:
+            # same transfer-before-release as _reply_ok
+            if caller and r.owner_id() == self.worker.worker_id:
+                self.worker.refcounter.add_borrower(r.id(), caller)
         owner_client.oneway("stream_item", msg)
         for r in so.contained_refs:
             self.worker.refcounter.on_serialized_ref_done(r.id())
