@@ -50,6 +50,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "node_head_watch_period_s": (float, 0.5, "node -> head liveness/incarnation poll period"),
     "head_recovery_grace_s": (float, 5.0, "restarted head waits this long for nodes to re-register before declaring unreconciled actors/PGs lost"),
     "task_max_retries_default": (int, 3, "default retries for normal tasks"),
+    "memory_monitor_refresh_ms": (int, 250, "node RSS poll period; 0 disables the memory monitor (reference: memory_monitor_refresh_ms)"),
+    "memory_usage_threshold": (float, 0.95, "node memory fraction above which the OOM killer picks a victim (reference: memory_usage_threshold)"),
+    "worker_memory_limit_bytes": (int, 0, "per-worker RSS cap, 0 = none; over-limit workers are OOM-killed"),
     "infeasible_grace_s": (float, 30.0, "wait for autoscaling before failing infeasible resource shapes"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
     "max_lineage_bytes": (int, 64 * 1024**2, "lineage cache cap per owner"),

@@ -31,12 +31,21 @@ from ray_tpu.core.object_ref import ObjectRef
 class StreamState:
     """Owner-side record of one streaming task's progress."""
 
-    __slots__ = ("total", "error", "cv")
+    __slots__ = ("total", "error", "cv", "arrived", "closed")
 
     def __init__(self):
         self.total: Optional[int] = None   # item count, set at completion
         self.error: Optional[BaseException] = None
         self.cv = threading.Condition()
+        # indices whose values landed in the owner (memory store or shm
+        # location) — the generator's cleanup frees whatever the consumer
+        # never turned into an ObjectRef, otherwise every abandoned stream
+        # leaks its items in the owner process
+        self.arrived: set = set()
+        # set by generator cleanup BEFORE draining `arrived`: an item
+        # handler that loses the race records nothing and frees its item
+        # itself (record_arrival -> False)
+        self.closed = False
 
     def finish(self, total: Optional[int],
                error: Optional[BaseException] = None) -> None:
@@ -45,6 +54,13 @@ class StreamState:
                 self.total = total
             self.error = error if self.error is None else self.error
             self.cv.notify_all()
+
+    def record_arrival(self, index: int) -> bool:
+        with self.cv:
+            if self.closed:
+                return False
+            self.arrived.add(index)
+            return True
 
 
 class ObjectRefGenerator:
@@ -110,6 +126,47 @@ class ObjectRefGenerator:
         with self._state.cv:
             return self._state.total is not None \
                 or self._state.error is not None
+
+    def _cleanup(self) -> None:
+        """Free items the consumer never took a ref to (dropped generator
+        mid-stream). Consumed indices (< _next_idx) are governed by their
+        ObjectRefs' refcounts; everything else that arrived is freed here
+        and the backend forgets the stream state."""
+        st = self._state
+        with st.cv:
+            st.closed = True
+            leftover = sorted(i for i in st.arrived if i >= self._next_idx)
+            st.arrived.clear()
+        backend = getattr(self._worker, "backend", None)
+        if backend is not None:
+            try:
+                backend.unregister_stream(self._task_id)
+            except Exception:  # noqa: BLE001
+                pass
+        if not leftover:
+            return
+        worker, task_id = self._worker, self._task_id
+
+        def _free_all() -> None:
+            # off-thread: each shm-resident item's free is a blocking node
+            # RPC — running N of those inside __del__ would stall whatever
+            # application thread happened to drop the last reference
+            for i in leftover:
+                oid = ObjectID.for_return(task_id, i)
+                try:
+                    worker.refcounter.untrack(oid)
+                    worker._free_object(oid)
+                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                    pass
+
+        threading.Thread(target=_free_all, daemon=True,
+                         name="stream-reap").start()
+
+    def __del__(self):
+        try:
+            self._cleanup()
+        except Exception:  # noqa: BLE001 — never raise from GC
+            pass
 
     def __repr__(self):
         return f"ObjectRefGenerator({self._task_id.hex()[:16]})"

@@ -317,21 +317,30 @@ class LocalBackend:
             return self._streams.get(spec.task_id.binary())
 
     def _finish_stream(self, spec: TaskSpec, total, error) -> None:
-        """Complete + drop the stream state (popping mirrors the cluster
-        backend's _finish_stream — a long-lived driver must not accumulate
-        one StreamState per streaming call)."""
+        """Complete the stream; the entry stays until the generator is
+        GC'd (unregister_stream), which also frees unconsumed items."""
         with self._lock:
-            state = self._streams.pop(spec.task_id.binary(), None)
+            state = self._streams.get(spec.task_id.binary())
         if state is not None:
             if error is not None and not isinstance(
                     error, (TaskError, ActorDiedError, TaskCancelledError)):
                 error = TaskError.from_exception(error)
             state.finish(total, error)
 
+    def unregister_stream(self, task_id) -> None:
+        with self._lock:
+            self._streams.pop(task_id.binary(), None)
+
     def _store_stream_item(self, spec: TaskSpec, index: int, value) -> None:
         oid = ObjectID.for_return(spec.task_id, index)
         self.worker.refcounter.mark_owned(oid)
         self.worker.memory_store.put(oid, value)
+        state = self._stream_state(spec)
+        if state is None or not state.record_arrival(index):
+            # straggler after the generator was dropped: free immediately,
+            # nothing will ever consume it (mirrors the cluster backend)
+            self.worker.refcounter.untrack(oid)
+            self.worker.memory_store.delete(oid)
 
     def _drain_stream(self, spec: TaskSpec, result) -> None:
         i = 0

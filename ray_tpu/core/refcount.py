@@ -148,6 +148,13 @@ class ReferenceCounter:
                 self._counts[object_id] = c
             c.owned = True
 
+    def untrack(self, object_id: ObjectID) -> None:
+        """Forget an owned object that never got a live ObjectRef (e.g. an
+        unconsumed streamed item being cleaned up) — without this the
+        mark_owned entry lingers forever since no ref removal will fire."""
+        with self._lock:
+            self._counts.pop(object_id, None)
+
     def is_tracked(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id in self._counts

@@ -40,7 +40,8 @@ from ray_tpu.core._native import ShmStore
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, JobID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
-from ray_tpu.exceptions import (ActorDiedError, PlacementGroupUnschedulableError,
+from ray_tpu.exceptions import (ActorDiedError, OutOfMemoryError,
+                                PlacementGroupUnschedulableError,
                                 TaskCancelledError, TaskError,
                                 WorkerCrashedError)
 from ray_tpu.runtime import wire
@@ -245,12 +246,31 @@ class _TaskSubmitter:
                 self.pending.appendleft(task)
             self._pump()
         else:
-            self.backend._store_task_error(
-                task.spec,
-                WorkerCrashedError(
+            fate = self._worker_fate(lease)
+            if fate == "oom":
+                err: BaseException = OutOfMemoryError(
+                    f"worker was OOM-killed running {task.spec.name} "
+                    f"(attempt {task.attempts}); raise the task's memory "
+                    f"request or the node's memory_usage_threshold")
+            else:
+                err = WorkerCrashedError(
                     f"worker died running {task.spec.name} "
-                    f"(attempt {task.attempts}): {exc}"),
-                task.pins)
+                    f"(attempt {task.attempts}): {exc}")
+            self.backend._store_task_error(task.spec, err, task.pins)
+
+    def _worker_fate(self, lease: _Lease) -> Optional[str]:
+        """Ask the worker's node daemon WHY it died (the submitter only
+        sees a dropped connection; the node records OOM kills —
+        reference: raylet death-cause propagation into task errors)."""
+        if not lease.node_addr:
+            return None
+        try:
+            return self.backend.peers.get(lease.node_addr).call(
+                "worker_fate",
+                {"worker_id": WorkerID(lease.worker_id).hex()},
+                timeout=5.0)
+        except RpcError:
+            return None
 
     def _release_to_cluster(self, lease: _Lease, timeout: float = 5.0) -> None:
         """Release via the head; if the head forgot the lease (it restarted
@@ -535,6 +555,7 @@ class ClusterBackend:
             "add_borrower": self.object_plane.handle_add_borrower,
             "remove_borrower": self.object_plane.handle_remove_borrower,
             "stream_item": self._h_stream_item,
+            "log_batch": self._h_log_batch,
             "ping": lambda p, c: "pong",
         }, name=f"{role}-owner")
         self.head.call_retrying("kv_put", {
@@ -795,6 +816,22 @@ class ClusterBackend:
         return ObjectRefGenerator(spec.task_id, self.worker.worker_id,
                                   self.worker, state)
 
+    def _h_log_batch(self, p, ctx):
+        """Worker stdout/stderr shipped by the executing worker's log
+        shipper (reference: log_monitor -> driver prints with the
+        (pid=...) prefix, _private/worker.py:1970). Only processes that
+        submitted work receive logs — output follows the caller."""
+        if not config_mod.GlobalConfig.log_to_driver:
+            return True
+        prefix = f"({p.get('worker', '?')} pid={p.get('pid', '?')})"
+        for stream, line in p.get("lines", ()):
+            out = sys.stderr if stream == "stderr" else sys.stdout
+            try:
+                print(f"{prefix} {line}", file=out, flush=True)
+            except Exception:  # noqa: BLE001
+                break
+        return True
+
     def _h_stream_item(self, p, ctx):
         """A worker shipped one yielded value of a streaming task we own."""
         oid = ObjectID(p["object_id"])
@@ -804,11 +841,32 @@ class ClusterBackend:
         else:
             value = serialization.deserialize(p["inline"])
             self.worker.memory_store.put(oid, value, is_error=False)
+        # state lookup AFTER the store: checking before would let a
+        # concurrent generator cleanup (which drains the arrival set and
+        # unregisters) slip between the check and the store, stranding the
+        # freshly-stored item outside both cleanup paths
+        with self._lock:
+            state = self._streams.get(p["task_id"])
+        recorded = state is not None and \
+            state.record_arrival(p.get("index", 0))
+        if not recorded:
+            # straggler after the generator was dropped and cleaned up:
+            # nothing will ever consume or free this item — free it now
+            self.worker.refcounter.untrack(oid)
+            self.worker._free_object(oid)
         return True
 
-    def _finish_stream(self, spec: TaskSpec, total, error) -> None:
+    def unregister_stream(self, task_id) -> None:
         with self._lock:
-            state = self._streams.pop(spec.task_id.binary(), None)
+            self._streams.pop(task_id.binary(), None)
+
+    def _finish_stream(self, spec: TaskSpec, total, error) -> None:
+        # the entry stays in _streams until the generator is GC'd
+        # (unregister_stream): stragglers arriving after the reply must
+        # still find the state, and the generator's cleanup needs the
+        # arrival set to free unconsumed items
+        with self._lock:
+            state = self._streams.get(spec.task_id.binary())
         if state is not None:
             state.finish(total, error)
 

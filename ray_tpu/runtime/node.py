@@ -15,6 +15,7 @@ restart logic runs (gcs_actor_manager.cc:413).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import subprocess
@@ -97,6 +98,7 @@ class NodeDaemon:
             "delete_object": self._h_delete_object,
             "store_stats": lambda p, c: self.store.stats(),
             "list_workers": self._h_list_workers,
+            "worker_fate": self._h_worker_fate,
             "ping": lambda p, c: "pong",
             "shutdown": self._h_shutdown,
         }, host=host, port=port, max_workers=32, name="node")
@@ -116,6 +118,16 @@ class NodeDaemon:
         # WorkerPool idle eviction, worker_pool.h:224)
         threading.Thread(target=self._idle_reap_loop, daemon=True,
                          name="node-idle-reap").start()
+        # why a worker was killed (e.g. "oom"), kept for submitters that
+        # see only a dropped connection and need the real cause
+        self._fates: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        if cfg.memory_monitor_refresh_ms > 0:
+            # memory monitor + OOM worker killing (reference:
+            # common/memory_monitor.h:52 polling + retriable-FIFO victim
+            # policy, raylet/worker_killing_policy_retriable_fifo.h)
+            threading.Thread(target=self._memory_monitor_loop, daemon=True,
+                             name="node-mem-monitor").start()
         for _ in range(cfg.worker_pool_prestart):
             self._spawn_worker()
 
@@ -260,8 +272,11 @@ class NodeDaemon:
         entry.ready.set()
         if self._stopped.is_set() or prev_state == "stopping":
             return
+        with self._lock:
+            fate = self._fates.get(WorkerID(entry.worker_id).hex())
         report = {"worker_id": entry.worker_id, "node_id": self.node_id,
-                  "reason": f"exit code {rc}"}
+                  "reason": "oom-killed" if fate == "oom"
+                            else f"exit code {rc}"}
         try:
             self._clients.get(self.head_addr).call("worker_died", report)
         except RpcError:
@@ -269,6 +284,108 @@ class NodeDaemon:
             # actor death during head downtime still triggers its restart
             with self._lock:
                 self._dead_unreported.append(report)
+
+    # --------------------------------------------------------- memory monitor
+
+    @staticmethod
+    def _rss_bytes(pid: int) -> Optional[int]:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError, IndexError):
+            return None
+
+    @staticmethod
+    def _node_memory() -> Optional[tuple]:
+        """(available, total) bytes from /proc/meminfo."""
+        try:
+            fields = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    fields[k] = int(v.strip().split()[0]) * 1024
+            return fields["MemAvailable"], fields["MemTotal"]
+        except (OSError, KeyError, ValueError):
+            return None
+
+    def _record_fate(self, worker_id: bytes, reason: str) -> None:
+        with self._lock:
+            self._fates[WorkerID(worker_id).hex()] = reason
+            while len(self._fates) > 256:
+                self._fates.popitem(last=False)
+
+    def _h_worker_fate(self, p, ctx):
+        with self._lock:
+            return self._fates.get(p["worker_id"])
+
+    def _oom_kill(self, entry: "_WorkerEntry", why: str) -> None:
+        self._record_fate(entry.worker_id, "oom")
+        print(f"MEMORY MONITOR: killing worker pid={entry.proc.pid} "
+              f"({why})", file=sys.stderr, flush=True)
+        try:
+            entry.proc.kill()
+        except OSError:
+            pass
+
+    def _memory_monitor_loop(self) -> None:
+        cfg = config_mod.GlobalConfig
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        last_victim: Optional[bytes] = None
+        victim_deadline = 0.0
+        while not self._stopped.wait(period):
+            limit = cfg.worker_memory_limit_bytes
+            with self._lock:
+                busy = [w for w in self._workers.values()
+                        if w.state in ("leased", "actor")]
+                fated = set(self._fates)
+            # exclude workers already being killed: their RSS lingers
+            # until the kernel reclaims, and re-selecting them (or their
+            # neighbours) every tick is the cascade the grace below stops
+            busy = [w for w in busy
+                    if WorkerID(w.worker_id).hex() not in fated]
+            # per-worker cap: deterministic, checked first
+            if limit > 0:
+                for w in busy:
+                    rss = self._rss_bytes(w.proc.pid)
+                    if rss is not None and rss > limit:
+                        self._oom_kill(
+                            w, f"rss {rss >> 20} MiB > limit "
+                               f"{limit >> 20} MiB")
+            # node-level pressure: ONE victim at a time, and no further
+            # kills until the previous victim's process actually exited
+            # (or a timeout passes) — /proc/meminfo lags SIGKILL reclaim
+            # by several ticks, and killing on stale numbers wipes out
+            # healthy workers (reference: MemoryMonitor waits for the
+            # victim's death before re-evaluating)
+            if last_victim is not None:
+                with self._lock:
+                    still_here = last_victim in self._workers
+                if still_here and time.monotonic() < victim_deadline:
+                    continue
+                last_victim = None
+            mem = self._node_memory()
+            if mem is None:
+                continue
+            available, total = mem
+            if total <= 0 or \
+                    1.0 - available / total < cfg.memory_usage_threshold:
+                continue
+            # retriable-FIFO: newest leased (task) worker first, actors
+            # only if no task worker exists (reference:
+            # worker_killing_policy_retriable_fifo.h — retriable tasks
+            # die before harder-to-restart work)
+            victims = sorted((w for w in busy if w.state == "leased"),
+                             key=lambda w: w.proc.pid, reverse=True) or \
+                sorted((w for w in busy if w.state == "actor"),
+                       key=lambda w: w.proc.pid, reverse=True)
+            if victims:
+                used_frac = 1.0 - available / total
+                self._oom_kill(
+                    victims[0],
+                    f"node memory {used_frac:.0%} > "
+                    f"{cfg.memory_usage_threshold:.0%}")
+                last_victim = victims[0].worker_id
+                victim_deadline = time.monotonic() + 10.0
 
     def _h_worker_ready(self, p, ctx):
         worker_id = p["worker_id"]

@@ -36,6 +36,117 @@ from ray_tpu.runtime import wire
 from ray_tpu.runtime.protocol import DEFERRED, RpcClient, RpcError
 
 
+class _LogShipper:
+    """Forwards worker stdout/stderr to the submitting owner process.
+
+    Role-equivalent to the reference's log monitor -> GCS pubsub -> driver
+    print pipeline (reference: python/ray/_private/log_monitor.py,
+    worker.py:1970 prints with the (pid=...) prefix) — redesigned as a
+    direct worker->owner push: output produced WHILE a task runs is
+    attributed to that task's submitter via a contextvar, so prints land
+    on the process that called .remote(), not a global driver.
+    """
+
+    MAX_BUFFER = 10_000  # lines; overflow drops the OLDEST, keeps the tail
+
+    def __init__(self, backend):
+        self.backend = backend
+        # contextvar, not a thread-local: async actor methods run as
+        # interleaved coroutines on ONE loop thread, and the context
+        # captured at dispatch (run_coroutine_threadsafe copies the
+        # submitting thread's context into the Task) keeps each
+        # coroutine's prints attributed to ITS caller
+        import contextvars
+        self._owner_var = contextvars.ContextVar("rtpu_log_owner",
+                                                 default=None)
+        self._lock = threading.Lock()
+        import collections as _collections
+        self._buf: "_collections.deque" = _collections.deque()
+        self._last_owner: Optional[bytes] = None
+        self._dropped = 0
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name="log-ship").start()
+
+    # -- attribution --
+
+    def set_owner(self, owner: Optional[bytes]) -> None:
+        self._owner_var.set(owner)
+        if owner:
+            self._last_owner = owner
+
+    def current_owner(self) -> Optional[bytes]:
+        # off-task output (background threads) goes to the most recent
+        # submitter — better than losing it
+        return self._owner_var.get() or self._last_owner
+
+    # -- production --
+
+    def emit(self, stream: str, text: str) -> None:
+        owner = self.current_owner()
+        if owner is None or not text:
+            return
+        with self._lock:
+            if len(self._buf) >= self.MAX_BUFFER:
+                # keep the newest output: the tail (the error) is the
+                # diagnostically valuable part of a runaway burst
+                self._buf.popleft()
+                self._dropped += 1
+            self._buf.append((owner, stream, text))
+
+    def _flush_loop(self) -> None:
+        while True:
+            time.sleep(0.2)
+            self.flush()
+
+    def flush(self) -> None:
+        import collections as _collections
+        with self._lock:
+            batch, self._buf = list(self._buf), _collections.deque()
+            dropped, self._dropped = self._dropped, 0
+        if not batch and not dropped:
+            return
+        by_owner: Dict[bytes, list] = {}
+        for owner, stream, text in batch:
+            by_owner.setdefault(owner, []).append((stream, text))
+        if dropped and batch:
+            by_owner.setdefault(batch[-1][0], []).append(
+                ("stderr", f"... {dropped} log lines dropped (buffer full)"))
+        me = self.backend.worker.worker_id.hex()[:8]
+        pid = os.getpid()
+        for owner, lines in by_owner.items():
+            try:
+                self.backend.object_plane.owner_client(
+                    WorkerID(owner)).oneway("log_batch", {
+                        "worker": me, "pid": pid, "lines": lines})
+            except Exception:  # noqa: BLE001 — log loss must never kill
+                pass
+
+
+class _TeeStream:
+    """File-like wrapper: writes through to the real stream AND ships
+    complete lines to the log shipper."""
+
+    def __init__(self, real, name: str, shipper: _LogShipper):
+        self._real = real
+        self._name = name
+        self._shipper = shipper
+        self._partial = ""
+
+    def write(self, text) -> int:
+        n = self._real.write(text)
+        self._partial += str(text)
+        while "\n" in self._partial:
+            line, self._partial = self._partial.split("\n", 1)
+            self._shipper.emit(self._name, line)
+        return n
+
+    def flush(self) -> None:
+        self._real.flush()
+
+    def __getattr__(self, attr):
+        return getattr(self._real, attr)
+
+
 class Executor:
     """Serial (or n-threaded, or asyncio-loop) execution of pushed tasks."""
 
@@ -65,6 +176,7 @@ class Executor:
         # control-plane probes never queue behind busy handler lanes.
         self._group_queues: Dict[str, "queue.Queue"] = {}
         self._method_groups: Dict[str, str] = {}
+        self.log_shipper: Optional[_LogShipper] = None
         self._start_threads(1)
 
     def _start_threads(self, n: int, q: Optional["queue.Queue"] = None,
@@ -202,6 +314,8 @@ class Executor:
             ctx.reply({"results": None, "cancelled": True})
             return
         self.worker.current_task_id = TaskID(task_id)
+        if self.log_shipper is not None:
+            self.log_shipper.set_owner(payload.get("owner") or None)
         t_start = time.time()
         try:
             args, kwargs = self._resolve_args(payload["args"],
@@ -470,6 +584,11 @@ def main() -> None:
     backend = ClusterBackend.connect_as_worker(
         global_worker, head_addr, shm_name, worker_id)
     executor = Executor(backend, global_worker)
+    if config_mod.GlobalConfig.log_to_driver:
+        shipper = _LogShipper(backend)
+        executor.log_shipper = shipper
+        sys.stdout = _TeeStream(sys.stdout, "stdout", shipper)
+        sys.stderr = _TeeStream(sys.stderr, "stderr", shipper)
     backend.server.handlers.update({
         "push_task": executor.handle_push_task,
         "become_actor": executor.handle_become_actor,

@@ -187,3 +187,37 @@ def test_streaming_local_mode(rtpu_local):
     assert ray_tpu.get(next(g), timeout=10) == 3
     with pytest.raises(StopIteration):
         next(g)
+
+
+def test_abandoned_stream_items_freed(rtpu_cluster):
+    """Dropping a generator mid-stream frees the unconsumed items in the
+    owner (memory store entries + refcount records) instead of leaking
+    them forever."""
+    import gc
+
+    ray_tpu = rtpu_cluster
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote(num_returns="streaming")
+    def burst():
+        for i in range(50):
+            yield ("x" * 2000, i)
+
+    base_tracked = global_worker.refcounter.num_tracked()
+    base_entries = global_worker.memory_store.size()
+    for _ in range(3):
+        g = burst.remote()
+        ray_tpu.get(next(g), timeout=60)  # consume ONE of 50
+        # wait for completion so all 50 items have arrived
+        deadline = time.monotonic() + 30
+        while not g.completed() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        del g
+        gc.collect()
+    # allow the cleanup path to run
+    time.sleep(0.5)
+    gc.collect()
+    leaked_tracked = global_worker.refcounter.num_tracked() - base_tracked
+    leaked_entries = global_worker.memory_store.size() - base_entries
+    assert leaked_tracked <= 6, f"refcount entries leaked: {leaked_tracked}"
+    assert leaked_entries <= 6, f"memory-store entries leaked: {leaked_entries}"
