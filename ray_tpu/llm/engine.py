@@ -19,6 +19,7 @@ admission, scheduling), rebuilt TPU-first:
 
 from __future__ import annotations
 
+import collections
 import functools
 import itertools
 import threading
@@ -102,6 +103,17 @@ class InferenceEngine:
         self.stats = {"prefill_tokens": 0, "decode_steps": 0,
                       "decode_tokens": 0, "decode_dispatches": 0}
         self._finished_at_prefill: Dict[str, List[int]] = {}
+        # tokens generated since the last drain_progress() call, per live
+        # request — the incremental surface token streaming rides on
+        # (reference: vLLM engine step() yielding RequestOutputs per step).
+        # OPT-IN: users that never drain (generate(), bench loops) must not
+        # accumulate every token ever generated
+        self.track_progress = False
+        self._progress: Dict[str, List[int]] = {}
+        # rid -> "stop" (EOS) | "length", for OpenAI finish_reason;
+        # bounded: consumers pop, non-consumers age out
+        self._finish_reasons: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
 
     # ------------------------------------------------------------ requests
 
@@ -185,9 +197,15 @@ class InferenceEngine:
             seq.generated = out
             seq.done = True
             self._finished_at_prefill[seq.request_id] = out
+            if out and self.track_progress:
+                self._progress.setdefault(seq.request_id, []).extend(out)
+            self._note_finish(seq.request_id,
+                              "stop" if not out else "length")
             self.allocator.free(pages)
             return
         seq.generated.append(first_tok)
+        if self.track_progress:
+            self._progress.setdefault(seq.request_id, []).append(first_tok)
         seq.slot = slot
         self._slots[slot] = seq
         with self._lock:
@@ -199,6 +217,8 @@ class InferenceEngine:
 
     def _finish(self, slot: int, seq: SequenceState,
                 finished: Dict[str, List[int]]) -> None:
+        if seq.request_id not in self._finish_reasons:
+            self._note_finish(seq.request_id, "length")
         seq.done = True
         finished[seq.request_id] = list(seq.generated)
         self.allocator.free(seq.pages)
@@ -253,9 +273,13 @@ class InferenceEngine:
             for j in range(K):
                 tok = int(block[j, slot])
                 if self.eos_token is not None and tok == self.eos_token:
+                    self._note_finish(seq.request_id, "stop")
                     self._finish(slot, seq, finished)
                     break
                 seq.generated.append(tok)
+                if self.track_progress:
+                    self._progress.setdefault(seq.request_id,
+                                              []).append(tok)
                 if len(seq.generated) >= seq.max_new_tokens:
                     self._finish(slot, seq, finished)
                     break
@@ -263,6 +287,21 @@ class InferenceEngine:
                 self._tokens[slot] = int(block[K - 1, slot])
                 self._positions[slot] = seq.num_tokens - 1
         return finished
+
+    def drain_progress(self) -> Dict[str, List[int]]:
+        """Tokens generated since the previous drain, per request id
+        (requires track_progress = True)."""
+        out, self._progress = self._progress, {}
+        return out
+
+    def _note_finish(self, rid: str, reason: str) -> None:
+        self._finish_reasons[rid] = reason
+        while len(self._finish_reasons) > 1024:
+            self._finish_reasons.popitem(last=False)
+
+    def finish_reason(self, rid: str) -> str:
+        """Why rid stopped: "stop" (EOS) or "length" (token budget)."""
+        return self._finish_reasons.pop(rid, "length")
 
     # ------------------------------------------------------------ blocking
 

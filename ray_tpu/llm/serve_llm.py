@@ -1,18 +1,28 @@
 """LLMServer — the serve deployment wrapping InferenceEngine.
 
-Role-equivalent to the reference's LLMDeployment (reference:
-llm/_internal/serve/deployments/llm/vllm/vllm_deployment.py): requests
-arriving on any of the replica's handler threads enqueue into the engine
-and block on a per-request event; a single engine thread runs the
-continuous-batching loop, so concurrent requests share decode batches.
+Role-equivalent to the reference's LLMDeployment + OpenAI surface
+(reference: llm/_internal/serve/deployments/llm/vllm/vllm_deployment.py;
+configs/openai_api_models.py request/response schemas): requests arriving
+on any of the replica's handler threads enqueue into the engine and block
+on a per-request event; a single engine thread runs the continuous-
+batching loop, so concurrent requests share decode batches.
+
+Token streaming: ``stream()`` is a generator — under serve it runs as a
+streaming actor method, every yielded token batch becomes consumable
+before the request finishes, and the HTTP proxy turns it into SSE
+(``/v1/completions`` with ``"stream": true``, the reference's OpenAI
+contract).
 """
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, Iterator, List, Optional
 
 from ray_tpu.llm.engine import InferenceEngine
+from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models.llama import LlamaConfig
 
 
@@ -22,12 +32,19 @@ class LLMServer:
     "max_tokens": N} and returns {"token_ids": [...]}."""
 
     def __init__(self, model_config: Optional[Dict[str, Any]] = None,
-                 engine_config: Optional[Dict[str, Any]] = None):
+                 engine_config: Optional[Dict[str, Any]] = None,
+                 tokenizer=None, model_name: str = "rtpu-llm"):
         cfg = LlamaConfig.tiny(**(model_config or {}))
         self.engine = InferenceEngine(cfg, **(engine_config or {}))
+        self.engine.track_progress = True  # the serve loop drains it
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.model_name = model_name
         self._results: Dict[str, List[int]] = {}
         self._events: Dict[str, threading.Event] = {}
         self._abandoned: set = set()
+        # rid -> queue of incremental token lists (None = stream end);
+        # fed by the engine thread, drained by stream() generators
+        self._token_qs: Dict[str, "queue_mod.Queue"] = {}
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -41,19 +58,27 @@ class LLMServer:
                 self._wake.clear()
                 continue
             finished = self.engine.step()
-            if finished:
-                with self._lock:
-                    for rid, toks in finished.items():
-                        if rid in self._abandoned:
-                            self._abandoned.discard(rid)
-                            continue
-                        self._results[rid] = toks
-                        ev = self._events.get(rid)
-                        if ev is not None:
-                            ev.set()
+            progress = self.engine.drain_progress()
+            with self._lock:
+                for rid, new_toks in progress.items():
+                    q = self._token_qs.get(rid)
+                    if q is not None and new_toks:
+                        q.put(list(new_toks))
+                for rid, toks in finished.items():
+                    q = self._token_qs.get(rid)
+                    if q is not None:
+                        q.put(None)  # end of stream
+                        continue
+                    if rid in self._abandoned:
+                        self._abandoned.discard(rid)
+                        continue
+                    self._results[rid] = toks
+                    ev = self._events.get(rid)
+                    if ev is not None:
+                        ev.set()
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        prompt = request["prompt_ids"]
+        prompt = self._prompt_ids(request)
         max_tokens = int(request.get("max_tokens", 32))
         ev = threading.Event()
         rid = self.engine.add_request(prompt, max_tokens)
@@ -74,6 +99,105 @@ class LLMServer:
             toks = self._results.pop(rid)
             self._events.pop(rid, None)
         return {"token_ids": toks, "request_id": rid}
+
+    # ------------------------------------------------------------ streaming
+
+    def stream(self, request: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+        """Generator: yields {"token_ids": [...]} batches as the engine
+        produces them, then {"done": True, "token_ids": <all>}."""
+        prompt = self._prompt_ids(request)
+        max_tokens = int(request.get("max_tokens", 32))
+        q: "queue_mod.Queue" = queue_mod.Queue()
+        with self._lock:
+            rid = self.engine.add_request(prompt, max_tokens)
+            self._token_qs[rid] = q
+        self._wake.set()
+        produced: List[int] = []
+        completed = False
+        try:
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    completed = True
+                    break
+                produced.extend(item)
+                yield {"token_ids": item, "request_id": rid}
+            yield {"done": True, "request_id": rid,
+                   "token_ids": list(produced),
+                   "finish_reason": self.engine.finish_reason(rid)}
+        finally:
+            with self._lock:
+                self._token_qs.pop(rid, None)
+                if not completed:
+                    # consumer went away mid-stream (disconnect/close):
+                    # the engine will still finish rid — mark abandoned so
+                    # _loop drops the late result instead of parking it in
+                    # _results forever, and drop any already-parked result
+                    self._results.pop(rid, None)
+                    self._abandoned.add(rid)
+
+    def _prompt_ids(self, request: Dict[str, Any]) -> List[int]:
+        if "prompt_ids" in request:
+            return list(request["prompt_ids"])
+        prompt = request.get("prompt")
+        if isinstance(prompt, str):
+            return self.tokenizer.encode(prompt)
+        if isinstance(prompt, list):
+            return list(prompt)
+        raise ValueError("request needs 'prompt' (str) or 'prompt_ids'")
+
+    # --------------------------------------------------------- OpenAI API
+
+    def _completion_body(self, rid: str, token_ids: List[int],
+                         n_prompt: int, finish_reason: str) -> Dict[str, Any]:
+        return {
+            "id": f"cmpl-{rid}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{"index": 0,
+                         "text": self.tokenizer.decode(token_ids),
+                         "token_ids": list(token_ids),
+                         "logprobs": None,
+                         "finish_reason": finish_reason}],
+            "usage": {"prompt_tokens": n_prompt,
+                      "completion_tokens": len(token_ids),
+                      "total_tokens": n_prompt + len(token_ids)},
+        }
+
+    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI-style /v1/completions, non-streaming (reference:
+        llm/_internal/serve/configs/openai_api_models.py
+        CompletionResponse)."""
+        prompt = self._prompt_ids(request)
+        out = self.__call__({"prompt_ids": prompt,
+                             "max_tokens": request.get("max_tokens", 32)})
+        return self._completion_body(
+            out["request_id"], out["token_ids"], len(prompt),
+            self.engine.finish_reason(out["request_id"]))
+
+    def completions_stream(self, request: Dict[str, Any]
+                           ) -> Iterator[Dict[str, Any]]:
+        """OpenAI-style streaming chunks (SSE framing happens in the
+        proxy); each chunk carries the newly-decoded text delta."""
+        prompt = self._prompt_ids(request)
+        rid = None
+        for item in self.stream({"prompt_ids": prompt,
+                                 "max_tokens":
+                                     request.get("max_tokens", 32)}):
+            rid = item["request_id"]
+            if item.get("done"):
+                chunk = self._completion_body(
+                    rid, [], len(prompt),
+                    item.get("finish_reason", "length"))
+                chunk["object"] = "text_completion.chunk"
+                yield chunk
+                return
+            chunk = self._completion_body(rid, item["token_ids"],
+                                          len(prompt), None)
+            chunk["object"] = "text_completion.chunk"
+            chunk.pop("usage")
+            yield chunk
 
     def stats(self) -> Dict[str, Any]:
         return dict(self.engine.stats)

@@ -1,0 +1,24 @@
+"""Byte-level tokenizer for string prompts.
+
+The reference delegates tokenization to HuggingFace tokenizers loaded per
+model (reference: llm/_internal/serve deployments pass prompts through the
+vLLM engine's tokenizer). This build's models are weight-free test-scale
+configs, so string handling uses the simplest lossless scheme: UTF-8
+bytes ARE the token ids (vocab 256 — exactly LlamaConfig.tiny's). Real
+checkpoints would plug their own tokenizer in via LLMServer(tokenizer=...).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class ByteTokenizer:
+    vocab_size = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(max(0, min(255, int(i))) for i in ids).decode(
+            "utf-8", errors="replace")

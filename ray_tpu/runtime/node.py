@@ -289,9 +289,14 @@ class NodeDaemon:
 
     @staticmethod
     def _rss_bytes(pid: int) -> Optional[int]:
+        """Private RSS (resident minus shared pages): zero-copy views of
+        shm-store objects must not count against a worker's cap — they are
+        the node's arena, not the worker's memory."""
         try:
             with open(f"/proc/{pid}/statm") as f:
-                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+                fields = f.read().split()
+            resident, shared = int(fields[1]), int(fields[2])
+            return max(0, resident - shared) * os.sysconf("SC_PAGE_SIZE")
         except (OSError, ValueError, IndexError):
             return None
 

@@ -2,9 +2,18 @@
 
 Role-equivalent to the reference's per-node proxy (reference:
 serve/_private/proxy.py:752 HTTPProxy over uvicorn/starlette ASGI),
-rebuilt on the stdlib ThreadingHTTPServer (no external deps): routes
-``/{deployment}`` to a DeploymentHandle, JSON bodies in/out. Streaming
-responses and gRPC ingress are out of scope for the MVP.
+rebuilt on the stdlib ThreadingHTTPServer (no external deps):
+
+ - ``/{deployment}[/{method}]``: JSON body in, ``{"result": ...}`` out;
+   a body with ``"stream": true`` switches to Server-Sent Events — each
+   item the deployment method yields becomes one ``data:`` frame,
+   terminated by ``data: [DONE]`` (reference: serve streaming responses
+   + the OpenAI SSE contract).
+ - ``/v1/completions``: OpenAI-compatible completions routed to the
+   deployment named by the body's ``"model"`` field (reference:
+   llm/_internal/serve/deployments/routers/router.py).
+
+gRPC ingress is out of scope.
 """
 
 from __future__ import annotations
@@ -29,7 +38,20 @@ class HTTPProxy:
                 pass
 
             def _dispatch(self, body: Any):
-                name = self.path.strip("/").split("/")[0]
+                parts = [p for p in self.path.strip("/").split("/") if p]
+                stream = isinstance(body, dict) and bool(body.get("stream"))
+                # OpenAI-compatible completions: the deployment is the
+                # body's "model" (reference: serve-LLM router)
+                if parts[:2] == ["v1", "completions"]:
+                    if not isinstance(body, dict) or "model" not in body:
+                        self._reply(400, {"error": "body needs 'model'"})
+                        return
+                    name = body["model"]
+                    method = ("completions_stream" if stream
+                              else "completions")
+                else:
+                    name = parts[0] if parts else ""
+                    method = parts[1] if len(parts) > 1 else None
                 if not name:
                     self._reply(404, {"error": "no deployment in path"})
                     return
@@ -43,15 +65,67 @@ class HTTPProxy:
                     self._reply(503, {"error": f"routing unavailable: "
                                                f"{e!r}"})
                     return
+                openai = parts[:2] == ["v1", "completions"]
                 try:
+                    if method:
+                        if method.startswith("_"):
+                            raise AttributeError(method)
+                        handle = getattr(handle, method)
+                except AttributeError:
+                    self._reply(404, {"error": f"no method {method!r}"})
+                    return
+                try:
+                    if stream:
+                        gen = handle.options(stream=True).remote(body)
+                        self._reply_sse(gen)
+                        return
                     if body is None:
                         resp = handle.remote()
                     else:
                         resp = handle.remote(body)
                     result = resp.result(timeout=60.0)
-                    self._reply(200, {"result": result})
+                    # OpenAI clients read top-level id/choices — no wrapper
+                    self._reply(200, result if openai
+                                else {"result": result})
                 except Exception as e:  # noqa: BLE001 — app fault boundary
                     self._reply(500, {"error": repr(e)})
+
+            def _reply_sse(self, gen):
+                """Server-Sent Events over chunked transfer: one data:
+                frame per yielded item, [DONE] terminator (the OpenAI
+                stream framing clients already speak)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for item in gen:
+                        try:
+                            payload = json.dumps(item)
+                        except (TypeError, ValueError):
+                            payload = json.dumps({"repr": repr(item)})
+                        chunk(f"data: {payload}\n\n".encode())
+                    chunk(b"data: [DONE]\n\n")
+                except BrokenPipeError:
+                    return  # client went away mid-stream
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        chunk(f"data: {json.dumps({'error': repr(e)})}"
+                              f"\n\n".encode())
+                    except OSError:
+                        return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")  # chunked EOF
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
             def _reply(self, code: int, payload: dict):
                 try:

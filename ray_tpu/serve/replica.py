@@ -60,6 +60,40 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args: Tuple,
+                                 kwargs: Dict[str, Any]):
+        """Streaming variant: the target must return an iterable/generator;
+        each item is yielded onward, so under ``num_returns="streaming"``
+        the caller consumes items while the request is still running
+        (reference: replica.py streaming responses over the generator
+        protocol)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self.callable
+            else:
+                target = getattr(self.callable, method_name, None)
+                if target is None:
+                    raise AttributeError(
+                        f"deployment {self.deployment_name} has no method "
+                        f"{method_name!r}")
+            out = target(*args, **kwargs)
+            if isinstance(out, (str, bytes, dict, set)) or \
+                    not hasattr(out, "__iter__"):
+                # iterating a dict/str would silently stream keys or
+                # characters — surface the contract violation instead
+                raise TypeError(
+                    f"streaming call to {self.deployment_name}."
+                    f"{method_name} returned {type(out).__name__}, "
+                    f"expected a generator/iterable of items")
+            for item in out:
+                yield item
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     # stats/health run on the "control" concurrency group so the
     # controller's probes never queue behind slow user requests occupying
     # every handler lane (reference: replica system-message concurrency).

@@ -52,6 +52,41 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator of streamed response items (reference: serve streaming
+    DeploymentResponseGenerator): wraps the ObjectRefGenerator from a
+    ``handle_request_streaming`` call and resolves each item ref to its
+    value; the router's in-flight count for the replica is released once,
+    when the stream ends (or this wrapper is dropped)."""
+
+    def __init__(self, ref_gen, on_done):
+        self._gen = ref_gen
+        self._on_done = on_done
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+        except BaseException:
+            self._finish()
+            raise
+        return ray_tpu.get(ref, timeout=300)
+
+    def _finish(self) -> None:
+        if not self._done:
+            self._done = True
+            try:
+                self._on_done()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __del__(self):
+        self._finish()
+
+
 class Router:
     TABLE_MAX_AGE_S = 2.0
 
@@ -102,6 +137,33 @@ class Router:
             qa = self._inflight.get(a.actor_id.hex(), 0)
             qb = self._inflight.get(b.actor_id.hex(), 0)
             return a if qa <= qb else b
+
+    def route_streaming(self, method_name: str, args: tuple,
+                        kwargs: dict) -> DeploymentResponseGenerator:
+        """Streamed call: items become consumable as the replica yields
+        them (rides num_returns='streaming' actor methods)."""
+        self._refresh()
+        replica = self._pick()
+        if replica is None:
+            self._refresh(force=True)
+            replica = self._pick()
+            if replica is None:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no live replicas")
+        key = replica.actor_id.hex()
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+
+        def done():
+            with self._lock:
+                self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+        try:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(method_name, args, kwargs)
+        except BaseException:
+            done()
+            raise
+        return DeploymentResponseGenerator(gen, done)
 
     def route(self, method_name: str, args: tuple,
               kwargs: dict) -> DeploymentResponse:
@@ -180,25 +242,38 @@ class DeploymentHandle:
     ``h.method.remote(...)`` calls a named method."""
 
     def __init__(self, controller, deployment_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self._controller = controller
         self._name = deployment_name
         self._method = method_name
+        self._stream = stream
         self._router = Router(controller, deployment_name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def options(self, stream: bool = False) -> "DeploymentHandle":
+        """handle.options(stream=True).remote(...) iterates the
+        deployment method's yielded items as they are produced
+        (reference: serve handle options(stream=True))."""
+        h = DeploymentHandle(self._controller, self._name,
+                             method_name=self._method, stream=stream)
+        h._router = self._router
+        return h
+
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return self._router.route_streaming(self._method, args, kwargs)
         return self._router.route(self._method, args, kwargs)
 
     def __getattr__(self, item: str) -> "DeploymentHandle":
         if item.startswith("_"):
             raise AttributeError(item)
-        h = DeploymentHandle(self._controller, self._name, method_name=item)
+        h = DeploymentHandle(self._controller, self._name, method_name=item,
+                             stream=self._stream)
         h._router = self._router  # share in-flight state across methods
         return h
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._name, self._method))
+                (self._controller, self._name, self._method, self._stream))
 
     # Handles are value-equal by target: deploy() compares old vs new
     # init_args to decide whether a redeploy must restart replicas, and a
