@@ -8,13 +8,16 @@ block pipeline with numpy-columnar blocks and static-shape batch iteration
 """
 
 from ray_tpu.data.block import Block, BlockAccessor
-from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
+                                  GroupedData, MaterializedDataset)
+from ray_tpu.data._internal.shuffle import AggregateFn
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
     from_items, from_numpy, range, read_csv, read_json, read_npy,
     read_parquet, read_text)
 
 __all__ = [
+    "ActorPoolStrategy", "AggregateFn", "GroupedData",
     "Block", "BlockAccessor", "Dataset", "MaterializedDataset",
     "DataIterator", "from_items", "from_numpy", "range", "read_csv",
     "read_json", "read_npy", "read_parquet", "read_text",

@@ -17,6 +17,7 @@ data/_internal/logical/). Redesigned TPU-first:
 from __future__ import annotations
 
 import copy
+import inspect
 import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
@@ -24,7 +25,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 import ray_tpu
-from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.block import Block, BlockAccessor, block_meta
 from ray_tpu.data._internal.streaming_executor import (
     ExecStats, execute_streaming)
 
@@ -138,9 +139,92 @@ class Dataset:
 
     def map_batches(self, fn: Callable[[Any], Any], *,
                     batch_format: str = "dict",
-                    batch_size: Optional[int] = None) -> "Dataset":
+                    batch_size: Optional[int] = None,
+                    compute: Optional["ActorPoolStrategy"] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None
+                    ) -> "Dataset":
+        """Per-batch transform. With ``compute=ActorPoolStrategy(n)`` and
+        a CLASS for ``fn``, batches run on a pool of n stateful actors —
+        the class is constructed once per actor (model-per-actor
+        inference; reference: ActorPoolMapOperator,
+        data/_internal/execution/operators/actor_pool_map_operator.py)."""
+        if compute is not None or inspect.isclass(fn):
+            if not inspect.isclass(fn):
+                raise ValueError(
+                    "compute=ActorPoolStrategy requires a class UDF "
+                    "(constructed once per pool actor)")
+            compute = compute or ActorPoolStrategy()
+            return _ActorStageDataset(
+                upstream=self, cls=fn,
+                ctor_args=tuple(fn_constructor_args),
+                ctor_kwargs=dict(fn_constructor_kwargs or {}),
+                size=compute.size, batch_format=batch_format,
+                batch_size=batch_size,
+                ray_remote_args=dict(self._plan.ray_remote_args))
         return self._with_transform(
             _map_batches_transform(fn, batch_format, batch_size))
+
+    # ----------------------------------------------------- shuffle family
+
+    def sort(self, key=None, descending: bool = False) -> "Dataset":
+        """Global sort via range-partition exchange (reference:
+        dataset.sort -> SortTaskSpec sample + range partition + per-range
+        sort, data/_internal/planner/exchange/sort_task_spec.py)."""
+        from ray_tpu.data._internal import shuffle as sh
+        mat = self.materialize()
+        refs = mat._refs  # noqa: SLF001
+        if not refs:
+            return mat
+        num_parts = max(1, len(refs))
+        kf = sh.key_fn(key)
+
+        # sample each block for range boundaries (one small task per block)
+        @ray_tpu.remote
+        def sample(block, k=32):
+            rows = BlockAccessor.for_block(block).to_rows()
+            if not rows:
+                return []
+            idx = np.linspace(0, len(rows) - 1,
+                              min(k, len(rows))).astype(int)
+            return [kf(rows[i]) for i in idx]
+
+        samples: List[Any] = []
+        for part in ray_tpu.get([sample.remote(r) for r in refs],
+                                timeout=600):
+            samples.extend(part)
+        samples.sort()
+        if not samples:
+            return mat
+        # fewer samples than partitions (tiny/ragged datasets) would index
+        # negatively and build non-monotonic boundaries -> silent missort
+        num_parts = min(num_parts, len(samples))
+        boundaries = [samples[max(0, (i + 1) * len(samples)
+                                  // num_parts - 1)]
+                      for i in range(num_parts - 1)]
+        out = sh.exchange(
+            refs, sh._map_range_partition, (key, boundaries),
+            sh._reduce_sort, (key, descending), num_parts,
+            ray_remote_args=self._plan.ray_remote_args)
+        if descending:
+            out = list(reversed(out))
+        return MaterializedDataset(out)
+
+    def groupby(self, key) -> "GroupedData":
+        """Hash-partition the dataset by key for aggregation /
+        per-group transforms (reference: dataset.groupby -> GroupedData,
+        grouped_data.py over the aggregate exchange)."""
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Whole-dataset aggregation (single implicit group)."""
+        gd = GroupedData(self, key=None, whole=True)
+        rows = gd.aggregate(*aggs).take_all()
+        if not rows:
+            return {}
+        row = dict(rows[0])
+        row.pop("key", None)
+        return row
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Shuffle block order globally + rows within each block.
@@ -346,3 +430,203 @@ class MaterializedDataset(Dataset):
             return lambda: ray_tpu.get(ref)
         super().__init__(_Plan(read_fns=[mk(r) for r in self._refs],
                                limit_rows=limit_rows))
+
+
+class GroupedData:
+    """Result of ``ds.groupby(key)`` (reference: data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key, whole: bool = False):
+        self._ds = ds
+        self._key = key
+        # whole=True: single implicit group (Dataset.aggregate)
+        self._whole = whole
+
+    def _exchange(self, reduce_fn, reduce_args) -> Dataset:
+        from ray_tpu.data._internal import shuffle as sh
+        mat = self._ds.materialize()
+        refs = mat._refs  # noqa: SLF001
+        if not refs:
+            return mat
+        num_parts = 1 if self._whole else max(1, len(refs))
+        key = (lambda r: 0) if self._whole else self._key
+        out = sh.exchange(
+            refs, sh._map_hash_partition, (key, num_parts),
+            reduce_fn, reduce_args, num_parts,
+            ray_remote_args=self._ds._plan.ray_remote_args)
+        return MaterializedDataset(out)
+
+    def aggregate(self, *aggs) -> Dataset:
+        """One output row per group: the key plus one column per
+        aggregation (AggregateFn instances)."""
+        from ray_tpu.data._internal import shuffle as sh
+        specs = [(a.name, a.fn) for a in aggs]
+        key = (lambda r: 0) if self._whole else self._key
+        return self._exchange(sh._reduce_groups, (key, specs))
+
+    def map_groups(self, fn) -> Dataset:
+        """Apply ``fn(rows) -> row | list[row]`` per group (reference:
+        grouped_data.map_groups)."""
+        from ray_tpu.data._internal import shuffle as sh
+        key = (lambda r: 0) if self._whole else self._key
+        return self._exchange(sh._reduce_map_groups, (key, fn))
+
+    def count(self) -> Dataset:
+        from ray_tpu.data._internal.shuffle import AggregateFn
+        return self.aggregate(AggregateFn.count())
+
+    def sum(self, col=None) -> Dataset:
+        from ray_tpu.data._internal.shuffle import AggregateFn
+        return self.aggregate(AggregateFn.sum(col))
+
+    def mean(self, col=None) -> Dataset:
+        from ray_tpu.data._internal.shuffle import AggregateFn
+        return self.aggregate(AggregateFn.mean(col))
+
+    def min(self, col=None) -> Dataset:
+        from ray_tpu.data._internal.shuffle import AggregateFn
+        return self.aggregate(AggregateFn.min(col))
+
+    def max(self, col=None) -> Dataset:
+        from ray_tpu.data._internal.shuffle import AggregateFn
+        return self.aggregate(AggregateFn.max(col))
+
+    def std(self, col=None) -> Dataset:
+        from ray_tpu.data._internal.shuffle import AggregateFn
+        return self.aggregate(AggregateFn.std(col))
+
+
+class ActorPoolStrategy:
+    """Compute strategy for stateful map_batches (reference:
+    data/_internal/compute.py ActorPoolStrategy — fixed size here; the
+    reference's min/max autoscaling rides the serve autoscaler design)."""
+
+    def __init__(self, size: int = 2):
+        if size < 1:
+            raise ValueError("ActorPoolStrategy size must be >= 1")
+        self.size = size
+
+
+class _BatchMapWorker:
+    """Pool actor hosting one constructed UDF instance."""
+
+    def __init__(self, cls_blob: bytes, args: tuple, kwargs: dict):
+        import cloudpickle
+        self._fn = cloudpickle.loads(cls_blob)(*args, **kwargs)
+
+    def apply(self, block: Block, batch_format: str,
+              batch_size: Optional[int]):
+        t = _map_batches_transform(self._fn, batch_format, batch_size)
+        out = t(block, 0)
+        return out, block_meta(out)
+
+
+class _ActorStageDataset(Dataset):
+    """Dataset whose execution feeds upstream blocks through a pool of
+    stateful actors (reference: ActorPoolMapOperator). Transforms chained
+    AFTER this stage run as ordinary fused tasks on the stage's outputs."""
+
+    def __init__(self, upstream: Dataset, cls, ctor_args: tuple,
+                 ctor_kwargs: dict, size: int, batch_format: str,
+                 batch_size: Optional[int],
+                 ray_remote_args: Dict[str, Any]):
+        super().__init__(_Plan(read_fns=[],
+                               ray_remote_args=dict(ray_remote_args)))
+        self._upstream = upstream
+        self._cls = cls
+        self._ctor_args = ctor_args
+        self._ctor_kwargs = ctor_kwargs
+        self._size = size
+        self._batch_format = batch_format
+        self._batch_size = batch_size
+
+    def _with_transform(self, t) -> "Dataset":
+        clone = _ActorStageDataset(
+            self._upstream, self._cls, self._ctor_args, self._ctor_kwargs,
+            self._size, self._batch_format, self._batch_size,
+            dict(self._plan.ray_remote_args))
+        clone._plan.transforms = self._plan.transforms + [t]
+        clone._plan.limit_rows = self._plan.limit_rows
+        return clone
+
+    def num_blocks(self) -> int:
+        return self._upstream.num_blocks()
+
+    def split(self, n: int) -> List["Dataset"]:
+        return self.materialize().split(n)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self.materialize().union(*others)
+
+    def limit(self, n: int) -> "Dataset":
+        # base limit() rebuilds a plain Dataset from our plan, whose
+        # read_fns is [] (blocks flow through _execute) — every row would
+        # silently vanish. Clone the stage and let iter_batches' row
+        # budget enforce the cap.
+        clone = self._with_transform(lambda b, i: b)
+        clone._plan.transforms = list(self._plan.transforms)
+        clone._plan.limit_rows = n if self._plan.limit_rows is None \
+            else min(self._plan.limit_rows, n)
+        return clone
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self.materialize().random_shuffle(seed=seed)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self.materialize().repartition(num_blocks)
+
+    def _execute(self) -> Iterator:
+        import time as _time
+
+        import cloudpickle
+        stats = ExecStats()
+        self._last_stats = stats
+        cls_blob = cloudpickle.dumps(self._cls)
+        worker_cls = ray_tpu.remote(_BatchMapWorker)
+        if self._plan.ray_remote_args:
+            worker_cls = worker_cls.options(**self._plan.ray_remote_args)
+        actors = [worker_cls.remote(cls_blob, self._ctor_args,
+                                    self._ctor_kwargs)
+                  for _ in range(self._size)]
+        fused = self._plan.fused()
+
+        @ray_tpu.remote(num_returns=2)
+        def _post(block: Block, idx: int):
+            out = fused(block, idx)
+            return out, block_meta(out)
+
+        t0 = _time.monotonic()
+
+        def emit(pair):
+            block_ref, meta_ref = pair
+            meta = ray_tpu.get(meta_ref, timeout=600)
+            stats.tasks += 1
+            stats.rows += meta["num_rows"]
+            stats.bytes += meta["size_bytes"]
+            stats.wall_s = _time.monotonic() - t0
+            return block_ref, meta
+
+        # round-robin over the pool with a bounded window; results yield
+        # in submission order (actor method queues keep per-actor FIFO, so
+        # each actor runs one batch at a time — the statefulness contract)
+        window: List[tuple] = []
+        cap = max(2, 2 * self._size)
+        try:
+            idx = 0
+            for block_ref, _ in self._upstream._execute():
+                actor = actors[idx % self._size]
+                pair = actor.apply.options(num_returns=2).remote(
+                    block_ref, self._batch_format, self._batch_size)
+                if fused is not None:
+                    pair = _post.remote(pair[0], idx)
+                window.append(pair)
+                idx += 1
+                while len(window) >= cap:
+                    yield emit(window.pop(0))
+            while window:
+                yield emit(window.pop(0))
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass

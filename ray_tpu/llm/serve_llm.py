@@ -191,6 +191,11 @@ class LLMServer:
                     rid, [], len(prompt),
                     item.get("finish_reason", "length"))
                 chunk["object"] = "text_completion.chunk"
+                # the terminal chunk is where OpenAI clients read usage:
+                # report the real completion count, not the empty delta
+                n_out = len(item.get("token_ids", ()))
+                chunk["usage"]["completion_tokens"] = n_out
+                chunk["usage"]["total_tokens"] = len(prompt) + n_out
                 yield chunk
                 return
             chunk = self._completion_body(rid, item["token_ids"],

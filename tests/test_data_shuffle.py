@@ -1,0 +1,124 @@
+"""Shuffle-family operators + stateful actor-pool map (reference:
+data/_internal/planner/exchange/ sort/aggregate task specs;
+execution/operators/actor_pool_map_operator.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.data import ActorPoolStrategy, AggregateFn
+from ray_tpu.data.read_api import from_items, range as range_ds
+
+
+@pytest.fixture(scope="module")
+def data_rt():
+    rt.init(num_cpus=4, _system_config={
+        "object_store_memory_bytes": 128 * 1024 * 1024,
+        "worker_pool_prestart": 2,
+    })
+    yield rt
+    rt.shutdown()
+
+
+# -------------------------------------------------------------------- sort
+
+
+def test_sort_scalars(data_rt):
+    ds = from_items([5, 3, 8, 1, 9, 2, 7, 4, 6, 0], num_blocks=3)
+    assert ds.sort().take_all() == list(range(10))
+    assert ds.sort(descending=True).take_all() == list(range(9, -1, -1))
+
+
+def test_sort_by_column(data_rt):
+    rows = [{"k": (7 * i + 3) % 20, "v": i} for i in range(20)]
+    ds = from_items(rows, num_blocks=4)
+    out = ds.sort(key="k").take_all()
+    ks = [r["k"] for r in out]
+    assert ks == sorted(ks)
+    assert len(out) == 20
+
+
+def test_sort_with_key_fn(data_rt):
+    ds = from_items(["bbb", "a", "cc", "dddd"], num_blocks=2)
+    assert ds.sort(key=len).take_all() == ["a", "cc", "bbb", "dddd"]
+
+
+# ----------------------------------------------------------------- groupby
+
+
+def test_groupby_count_and_sum(data_rt):
+    rows = [{"k": i % 3, "v": float(i)} for i in range(12)]
+    ds = from_items(rows, num_blocks=4)
+    counts = {r["k"]: r["count()"]
+              for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["k"]: r["sum(v)"]
+            for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+
+
+def test_groupby_multi_aggregate(data_rt):
+    rows = [{"k": "a" if i < 5 else "b", "v": float(i)} for i in range(10)]
+    ds = from_items(rows, num_blocks=3)
+    out = {r["k"]: r for r in ds.groupby("k").aggregate(
+        AggregateFn.mean("v"), AggregateFn.min("v"),
+        AggregateFn.max("v")).take_all()}
+    assert out["a"]["mean(v)"] == 2.0
+    assert out["a"]["min(v)"] == 0.0 and out["a"]["max(v)"] == 4.0
+    assert out["b"]["mean(v)"] == 7.0
+
+
+def test_groupby_map_groups(data_rt):
+    rows = [{"k": i % 2, "v": i} for i in range(8)]
+    ds = from_items(rows, num_blocks=2)
+    out = ds.groupby("k").map_groups(
+        lambda rows: {"k": rows[0]["k"],
+                      "vs": sorted(r["v"] for r in rows)}).take_all()
+    by_k = {r["k"]: list(r["vs"]) for r in out}
+    assert by_k == {0: [0, 2, 4, 6], 1: [1, 3, 5, 7]}
+
+
+def test_dataset_level_aggregate(data_rt):
+    ds = range_ds(100, num_blocks=5)  # rows are {"id": int}
+    out = ds.aggregate(AggregateFn.sum("id"), AggregateFn.count())
+    assert out["sum(id)"] == sum(range(100))
+    assert out["count()"] == 100
+
+
+# ------------------------------------------------------------- actor pools
+
+
+def test_map_batches_actor_pool(data_rt):
+    class AddModelBias:
+        """Stateful UDF: 'loads a model' once per pool actor."""
+
+        def __init__(self, bias):
+            import os
+            self.bias = bias
+            self.pid = os.getpid()
+
+        def __call__(self, batch):
+            return {"x": batch["x"] + self.bias, "pid":
+                    np.full(len(batch["x"]), self.pid)}
+
+    rows = [{"x": float(i)} for i in range(40)]
+    ds = from_items(rows, num_blocks=8).map_batches(
+        AddModelBias, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(100.0,))
+    out = ds.take_all()
+    assert sorted(r["x"] for r in out) == [100.0 + i for i in range(40)]
+    # the pool actually used distinct stateful actors
+    pids = {int(r["pid"]) for r in out}
+    assert 1 <= len(pids) <= 2
+
+
+def test_actor_pool_then_transform(data_rt):
+    class Doubler:
+        def __call__(self, batch):
+            return {"x": batch["x"] * 2}
+
+    ds = (from_items([{"x": float(i)} for i in range(10)], num_blocks=2)
+          .map_batches(Doubler, compute=ActorPoolStrategy(size=1))
+          .map(lambda r: {"x": r["x"] + 1}))
+    assert sorted(r["x"] for r in ds.take_all()) == \
+        [2.0 * i + 1 for i in range(10)]
