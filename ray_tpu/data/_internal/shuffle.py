@@ -52,14 +52,18 @@ def _stable_hash(value: Any) -> int:
 
 
 def _map_hash_partition(block: Block, key, num_parts: int) -> tuple:
+    if num_parts == 1:
+        return block  # single partition: skip per-row hashing entirely
     kf = key_fn(key)
     rows = BlockAccessor.for_block(block).to_rows()
     parts = _partition_rows(
         rows, lambda r: _stable_hash(kf(r)) % num_parts, num_parts)
-    return tuple(parts) if num_parts > 1 else parts[0]
+    return tuple(parts)
 
 
 def _map_range_partition(block: Block, key, boundaries: list) -> tuple:
+    if not boundaries:
+        return block  # single partition
     kf = key_fn(key)
     rows = BlockAccessor.for_block(block).to_rows()
     num_parts = len(boundaries) + 1

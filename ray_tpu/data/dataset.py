@@ -167,12 +167,43 @@ class Dataset:
 
     # ----------------------------------------------------- shuffle family
 
+    def _materialize_exact(self) -> "MaterializedDataset":
+        """Materialize with limit_rows APPLIED to the stored blocks.
+        materialize() only stops submission at the limit — the boundary
+        block keeps its extra rows, which exchange-based ops (sort/
+        groupby) would otherwise process and silently un-limit."""
+        if self._plan.limit_rows is None:
+            return self.materialize()
+
+        @ray_tpu.remote
+        def trunc(block: Block, n: int) -> Block:
+            acc = BlockAccessor.for_block(block)
+            sub = acc.slice(0, n)
+            # slices are views into the parent block: copy so the stored
+            # object doesn't pin the untruncated original
+            if isinstance(sub, dict):
+                return {k: np.array(v) for k, v in sub.items()}
+            if isinstance(sub, np.ndarray):
+                return np.array(sub)
+            return list(sub)
+
+        refs: List[Any] = []
+        budget = self._plan.limit_rows
+        for ref, meta in self._execute():
+            if budget <= 0:
+                break
+            take = min(meta["num_rows"], budget)
+            refs.append(ref if take == meta["num_rows"]
+                        else trunc.remote(ref, take))
+            budget -= take
+        return MaterializedDataset(refs)
+
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         """Global sort via range-partition exchange (reference:
         dataset.sort -> SortTaskSpec sample + range partition + per-range
         sort, data/_internal/planner/exchange/sort_task_spec.py)."""
         from ray_tpu.data._internal import shuffle as sh
-        mat = self.materialize()
+        mat = self._materialize_exact()
         refs = mat._refs  # noqa: SLF001
         if not refs:
             return mat
@@ -252,6 +283,10 @@ class Dataset:
         """Concatenate datasets. Each side's transform chain is baked into
         its read thunks so the union has a single (empty) chain."""
         def _baked(ds: "Dataset") -> List[Callable[[], Block]]:
+            if type(ds)._execute is not Dataset._execute:
+                # custom execution (e.g. an actor-pool stage): its plan has
+                # no read thunks — materialize to capture its real blocks
+                ds = ds.materialize()
             fused = ds._plan.fused()
             if fused is None:
                 return list(ds._plan.read_fns)
@@ -443,7 +478,7 @@ class GroupedData:
 
     def _exchange(self, reduce_fn, reduce_args) -> Dataset:
         from ray_tpu.data._internal import shuffle as sh
-        mat = self._ds.materialize()
+        mat = self._ds._materialize_exact()
         refs = mat._refs  # noqa: SLF001
         if not refs:
             return mat
@@ -530,7 +565,8 @@ class _ActorStageDataset(Dataset):
                  batch_size: Optional[int],
                  ray_remote_args: Dict[str, Any]):
         super().__init__(_Plan(read_fns=[],
-                               ray_remote_args=dict(ray_remote_args)))
+                               ray_remote_args=dict(ray_remote_args),
+                               limit_rows=upstream._plan.limit_rows))
         self._upstream = upstream
         self._cls = cls
         self._ctor_args = ctor_args
@@ -539,13 +575,18 @@ class _ActorStageDataset(Dataset):
         self._batch_format = batch_format
         self._batch_size = batch_size
 
-    def _with_transform(self, t) -> "Dataset":
+    def _clone(self) -> "_ActorStageDataset":
         clone = _ActorStageDataset(
             self._upstream, self._cls, self._ctor_args, self._ctor_kwargs,
             self._size, self._batch_format, self._batch_size,
             dict(self._plan.ray_remote_args))
-        clone._plan.transforms = self._plan.transforms + [t]
+        clone._plan.transforms = list(self._plan.transforms)
         clone._plan.limit_rows = self._plan.limit_rows
+        return clone
+
+    def _with_transform(self, t) -> "Dataset":
+        clone = self._clone()
+        clone._plan.transforms = clone._plan.transforms + [t]
         return clone
 
     def num_blocks(self) -> int:
@@ -562,8 +603,7 @@ class _ActorStageDataset(Dataset):
         # read_fns is [] (blocks flow through _execute) — every row would
         # silently vanish. Clone the stage and let iter_batches' row
         # budget enforce the cap.
-        clone = self._with_transform(lambda b, i: b)
-        clone._plan.transforms = list(self._plan.transforms)
+        clone = self._clone()
         clone._plan.limit_rows = n if self._plan.limit_rows is None \
             else min(self._plan.limit_rows, n)
         return clone

@@ -9,12 +9,15 @@ store.
 """
 
 from ray_tpu.rllib.algorithm import PPO, PPOConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.replay import ReplayBuffer
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVectorEnv, VectorEnv
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner, compute_gae
 from ray_tpu.rllib.module import forward, init_module, sample_actions
 
 __all__ = [
+    "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "PPO", "PPOConfig", "PPOLearner", "EnvRunner", "VectorEnv",
     "CartPoleVectorEnv", "ENV_REGISTRY", "compute_gae", "init_module",
     "forward", "sample_actions",

@@ -18,25 +18,50 @@ from ray_tpu.rllib.env import ENV_REGISTRY
 
 class EnvRunner:
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
-                 seed: int = 0):
+                 seed: int = 0, exploration: str = "categorical"):
+        """exploration: "categorical" samples the policy distribution
+        (on-policy, PPO); "epsilon_greedy" takes argmax over the logits
+        head (Q-values for DQN) with probability 1-epsilon (reference:
+        rllib exploration configs per algorithm)."""
         import jax
         self._jax = jax
         self.env = ENV_REGISTRY[env_name](num_envs)
         self.rollout_len = rollout_len
         self.obs = self.env.reset(seed=seed)
         self.params = None
+        self.exploration = exploration
+        self.epsilon = 1.0
         self._key = jax.random.PRNGKey(seed)
         self._sample = jax.jit(self._make_sample())
 
     def _make_sample(self):
-        from ray_tpu.rllib.module import sample_actions
+        import jax
+        import jax.numpy as jnp
 
-        def fn(params, obs, key):
-            return sample_actions(params, obs, key)
+        from ray_tpu.rllib.module import forward, sample_actions
+
+        if self.exploration == "categorical":
+            def fn(params, obs, key, epsilon):
+                return sample_actions(params, obs, key)
+            return fn
+
+        def fn(params, obs, key, epsilon):
+            logits, value = forward(params, obs)
+            greedy = jnp.argmax(logits, axis=-1)
+            k_explore, k_rand = jax.random.split(key)
+            rand = jax.random.randint(k_rand, greedy.shape, 0,
+                                      logits.shape[-1])
+            explore = jax.random.uniform(
+                k_explore, greedy.shape) < epsilon
+            actions = jnp.where(explore, rand, greedy)
+            # logp meaningless for Q-learning; zeros keep the batch shape
+            return actions, jnp.zeros_like(value), value
         return fn
 
-    def set_weights(self, params: Any) -> bool:
+    def set_weights(self, params: Any, epsilon: float = None) -> bool:
         self.params = params
+        if epsilon is not None:
+            self.epsilon = float(epsilon)
         return True
 
     def sample(self) -> Dict[str, np.ndarray]:
@@ -58,7 +83,8 @@ class EnvRunner:
         self.env.episode_returns.clear()
         for t in range(T):
             self._key, sub = self._jax.random.split(self._key)
-            actions, logp, values = self._sample(self.params, self.obs, sub)
+            actions, logp, values = self._sample(self.params, self.obs, sub,
+                                                 self.epsilon)
             actions = np.asarray(actions)
             out["obs"][t] = self.obs
             out["actions"][t] = actions
@@ -67,8 +93,10 @@ class EnvRunner:
             self.obs, rewards, dones, _ = self.env.step(actions)
             out["rewards"][t] = rewards
             out["dones"][t] = dones
-        _, _, last_value = self._sample(self.params, self.obs, self._key)
+        _, _, last_value = self._sample(self.params, self.obs, self._key,
+                                        self.epsilon)
         out["last_value"] = np.asarray(last_value)
+        out["last_obs"] = np.asarray(self.obs, np.float32)
         out["episode_returns"] = np.asarray(self.env.episode_returns,
                                             np.float32)
         return out
