@@ -163,8 +163,14 @@ class ReferenceCounter:
         with self._lock:
             return len(self._counts)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, limit: "Optional[int]" = None) -> dict:
+        """Debug/telemetry view of the count table; ``limit`` bounds the
+        under-lock work for large tables (telemetry samples)."""
+        import itertools
         with self._lock:
+            items = self._counts.items()
+            if limit is not None:
+                items = itertools.islice(items, limit)
             return {
                 oid.hex(): {
                     "local": c.local,
@@ -172,5 +178,5 @@ class ReferenceCounter:
                     "borrowers": len(c.borrowers),
                     "owned": c.owned,
                 }
-                for oid, c in self._counts.items()
+                for oid, c in items
             }

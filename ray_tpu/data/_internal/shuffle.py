@@ -72,7 +72,7 @@ def _map_range_partition(block: Block, key, boundaries: list) -> tuple:
         import bisect
         return bisect.bisect_right(boundaries, kf(r))
     parts = _partition_rows(rows, part_of, num_parts)
-    return tuple(parts) if num_parts > 1 else parts[0]
+    return tuple(parts)
 
 
 def exchange(block_refs: List[Any], map_fn: Callable[..., tuple],

@@ -591,12 +591,22 @@ class ClusterBackend:
         try:
             snap = metrics_mod.snapshot()
             events = self.event_buffer.drain()
-            if snap or events:
+            # bounded object-table summary for `list objects` (reference:
+            # util/state object listing; owners are authoritative, so each
+            # process reports its own table). snapshot(limit=...) keeps
+            # the under-lock work O(limit), not O(all refs).
+            tracked = self.worker.refcounter.num_tracked()
+            sample = [{"object_id": oid, **counts}
+                      for oid, counts in
+                      self.worker.refcounter.snapshot(limit=50).items()]
+            objects = {"tracked": tracked, "sample": sample}
+            if snap or events or tracked:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
                     "node": self.local_node_id,
-                    "metrics": snap, "events": events})
+                    "metrics": snap, "events": events,
+                    "objects": objects})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 
@@ -997,8 +1007,9 @@ class ClusterBackend:
                         "Address": n["address"]})
         return out
 
-    def state_dump(self) -> dict:
-        return self.head.call_retrying("state_dump")
+    def state_dump(self, task_limit: int = 200) -> dict:
+        return self.head.call_retrying("state_dump",
+                                       {"task_limit": task_limit})
 
     def _reap_loop(self) -> None:
         cfg = config_mod.GlobalConfig

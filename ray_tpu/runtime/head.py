@@ -181,6 +181,7 @@ class Head:
         # telemetry (reference: GcsTaskManager events + metrics agent):
         # per-worker metric snapshots + bounded task-span ring buffer
         self._metrics: Dict[str, dict] = {}
+        self._objects: Dict[str, dict] = {}  # worker -> object summary
         self._task_events: collections.deque = collections.deque(
             maxlen=cfg.event_buffer_size)
         # unserviceable demand, deduped per (requester, shape): each
@@ -1121,6 +1122,13 @@ class Head:
             if p.get("metrics"):
                 self._metrics[p["worker"]] = {
                     "ts": time.time(), "snap": p["metrics"]}
+            if p.get("objects") is not None:
+                # per-owner object summary for `list objects` (reference:
+                # the state API's object listing aggregates owner-side
+                # ref tables — ownership model: owners are authoritative)
+                self._objects[p["worker"]] = {
+                    "ts": time.time(), "node": p.get("node", ""),
+                    "role": p.get("role", ""), "snap": p["objects"]}
             for e in p.get("events", ()):
                 e["worker"] = p["worker"][:12]
                 e["node"] = p.get("node", "")
@@ -1175,8 +1183,20 @@ class Head:
         return {"demand": demand, "nodes": nodes}
 
     def _h_state_dump(self, p, ctx):
+        cutoff = time.time() - self.METRICS_STALE_S
         with self._lock:
+            for w in [w for w, e in self._objects.items()
+                      if e["ts"] < cutoff]:
+                del self._objects[w]
+            objects = [
+                {"owner": w[:12], "node": e["node"], "role": e["role"],
+                 **e["snap"]}
+                for w, e in self._objects.items()]
+            tasks = list(self._task_events)[-int(p.get("task_limit", 200)
+                                                if p else 200):]
             return {
+                "tasks": tasks,
+                "objects": objects,
                 "nodes": [{"node_id": n.node_id, "address": n.address,
                            "alive": n.alive, "resources": n.resources}
                           for n in self._nodes.values()],

@@ -126,15 +126,18 @@ def cmd_list(args) -> int:
             except Exception:
                 pass
     elif args.what == "objects":
-        rows = []
+        # per-owner object tables (ownership model) + per-node arena stats
+        rows = list(dump.get("objects", []))
         for n in dump["nodes"]:
             if not n["alive"]:
                 continue
             try:
                 st = _client(n["address"]).call("store_stats")
-                rows.append({"node_id": n["node_id"], **st})
+                rows.append({"node_id": n["node_id"], "store": st})
             except Exception:
                 pass
+    elif args.what == "tasks":
+        rows = dump.get("tasks", [])
     else:
         raise SystemExit(f"unknown list target {args.what}")
     if args.format == "json":
@@ -223,7 +226,8 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("what", choices=["nodes", "actors", "workers",
-                                     "placement-groups", "objects"])
+                                     "placement-groups", "objects",
+                                     "tasks"])
     sp.add_argument("--address")
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_list)

@@ -12,6 +12,8 @@ from typing import Any, Dict
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.searcher import (BasicVariantSearcher,
+                                   HyperOptLikeSearcher, Searcher)
 from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
                                  sample_from, uniform)
 from ray_tpu.tune.trial import Trial, TrialStatus, get_session
@@ -22,6 +24,7 @@ __all__ = [
     "TrialStatus", "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "PopulationBasedTraining", "uniform", "loguniform", "randint", "choice",
     "sample_from", "grid_search", "report", "get_checkpoint",
+    "Searcher", "BasicVariantSearcher", "HyperOptLikeSearcher",
 ]
 
 

@@ -27,6 +27,11 @@ class TuneConfig:
     scheduler: Optional[TrialScheduler] = None
     max_concurrent_trials: int = 0
     seed: Optional[int] = None
+    # Searcher plugin (reference: tune/search/searcher.py seam). When set,
+    # trials run in waves sized by max_concurrent_trials and results feed
+    # back through on_trial_complete between waves, so sequential
+    # model-based searchers actually see earlier results.
+    search_alg: Optional[Any] = None
 
 
 @dataclass
@@ -87,6 +92,8 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
+        if tc.search_alg is not None and self._restored_variants is None:
+            return self._fit_with_searcher()
         variants = self._restored_variants or generate_variants(
             self.param_space, tc.num_samples, seed=tc.seed)
         storage = self.run_config.storage_path
@@ -105,6 +112,61 @@ class Tuner:
             restore_state=(self._restored_state or {}).get("trials"))
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode, controller.storage)
+
+    def _fit_with_searcher(self) -> ResultGrid:
+        """Wave-based execution for Searcher plugins. Note: searcher
+        experiments persist per-wave state under wave_N/ and do NOT
+        support Tuner.restore() of the whole run (the searcher's model
+        state is not checkpointed — reference parity gap shared with
+        stateful search plugins)."""
+        tc = self.tune_config
+        searcher = tc.search_alg
+        searcher.set_search_properties(tc.metric, tc.mode, self.param_space)
+        wave_size = tc.max_concurrent_trials or 4
+        storage = self.run_config.storage_path
+        if storage and self.run_config.name:
+            storage = os.path.join(storage, self.run_config.name)
+        all_trials: List[Trial] = []
+        final_storage = storage
+        wave = 0
+        next_id = 0
+        while True:
+            batch = []  # [(searcher_id, config)]
+            while len(batch) < wave_size:
+                sid = f"srch_{next_id}"
+                cfg = searcher.suggest(sid)
+                if cfg is None:
+                    break
+                batch.append((sid, cfg))
+                next_id += 1
+            if not batch:
+                break
+            controller = TuneController(
+                self.trainable,
+                param_space=self.param_space,
+                variants=[cfg for _, cfg in batch],
+                metric=tc.metric, mode=tc.mode,
+                scheduler=tc.scheduler,
+                max_concurrent=tc.max_concurrent_trials,
+                resources_per_trial=self.run_config.resources_per_trial,
+                storage_path=(os.path.join(storage, f"wave_{wave}")
+                              if storage else None),
+                max_failures_per_trial=self.run_config
+                .max_failures_per_trial)
+            trials = controller.run()
+            final_storage = controller.storage
+            # feed results back in suggestion order (the controller keeps
+            # variant order) so the searcher's model sees this wave before
+            # proposing the next
+            for (sid, _), t in zip(batch, trials):
+                searcher.on_trial_complete(
+                    sid, t.last_result if t.last_result else None)
+                # disambiguate across waves: each controller restarts its
+                # id counter at t0000
+                t.trial_id = f"w{wave}_{t.trial_id}"
+            all_trials.extend(trials)
+            wave += 1
+        return ResultGrid(all_trials, tc.metric, tc.mode, final_storage)
 
     @classmethod
     def restore(cls, storage_path: str,

@@ -9,11 +9,11 @@ from typing import Dict, List
 from ray_tpu.core.worker import require_connected
 
 
-def _dump() -> dict:
+def _dump(task_limit: int = 200) -> dict:
     worker = require_connected()
     backend = worker.backend
     if hasattr(backend, "state_dump"):
-        return backend.state_dump()
+        return backend.state_dump(task_limit=task_limit)
     # local mode: synthesize from the in-process backend
     return {
         "nodes": [{"node_id": "local", "alive": True,
@@ -26,6 +26,10 @@ def _dump() -> dict:
                    for aid, a in backend.actors.items()],
         "leases": 0,
         "placement_groups": [],
+        "tasks": [],
+        "objects": [{"owner": "local", "node": "local", "role": "driver",
+                     "tracked": worker.refcounter.num_tracked(),
+                     "sample": []}],
     }
 
 
@@ -42,6 +46,21 @@ def list_actors(state: str = "") -> List[Dict]:
 
 def list_placement_groups() -> List[Dict]:
     return _dump()["placement_groups"]
+
+
+def list_tasks(limit: int = 200) -> List[Dict]:
+    """Recent task spans (name, kind, worker, node, timing, ok) — the
+    reference's `ray list tasks` surface (util/state/api.py:1011), served
+    from the head's task-event buffer."""
+    return _dump(task_limit=limit).get("tasks", [])[-limit:]
+
+
+def list_objects() -> List[Dict]:
+    """Per-owner object-table summaries (tracked count + a sample of
+    entries with local/submitted/borrower counts) — the reference's
+    `ray list objects` role under the ownership model: owners are the
+    authority, so the head aggregates their telemetry reports."""
+    return _dump().get("objects", [])
 
 
 def summarize() -> Dict:

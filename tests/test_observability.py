@@ -95,3 +95,35 @@ def test_task_timeline_records_spans(cluster_rt):
     from ray_tpu.runtime.events import to_chrome_trace
     trace = to_chrome_trace(spans)
     assert all(t["ph"] == "X" and t["dur"] > 0 for t in trace)
+
+
+def test_state_api_lists_tasks_and_objects(cluster_rt):
+    """`list tasks` / `list objects` (reference: util/state/api.py:1011
+    list_tasks, list_objects) — task spans from the head's event buffer,
+    object summaries from owner telemetry."""
+    import time as _t
+
+    from ray_tpu.util import state as state_api
+
+    @rt.remote
+    def traced(x):
+        return x + 1
+
+    ref = traced.remote(1)
+    keep = rt.put(list(range(2000)))  # a tracked object  # noqa: F841
+    assert rt.get(ref, timeout=60) == 2
+    # telemetry flushes every metrics_export_period_s; poll until visible
+    deadline = _t.monotonic() + 30
+    tasks, objects = [], []
+    while _t.monotonic() < deadline:
+        tasks = state_api.list_tasks()
+        objects = state_api.list_objects()
+        if any("traced" in (t.get("name") or "") for t in tasks) \
+                and objects:
+            break
+        _t.sleep(0.5)
+    names = [t.get("name") for t in tasks]
+    assert any("traced" in n for n in names), names
+    span = next(t for t in tasks if "traced" in (t.get("name") or ""))
+    assert span.get("ok") is True and "worker" in span
+    assert any(o.get("tracked", 0) > 0 for o in objects), objects
