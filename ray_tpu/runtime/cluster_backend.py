@@ -556,6 +556,7 @@ class ClusterBackend:
             "remove_borrower": self.object_plane.handle_remove_borrower,
             "stream_item": self._h_stream_item,
             "log_batch": self._h_log_batch,
+            "borrow_batch": self._h_borrow_batch,
             "ping": lambda p, c: "pong",
         }, name=f"{role}-owner")
         self.head.call_retrying("kv_put", {
@@ -565,6 +566,23 @@ class ClusterBackend:
         # borrowed-ref owner map for unborrow notifications
         self._borrowed_owner: Dict[ObjectID, WorkerID] = {}
         worker.refcounter.notify_owner_unborrow = self._notify_unborrow
+        # Borrow traffic batcher: add/remove-borrower notifications queue
+        # here and flush as one RPC per owner, preserving per-owner FIFO
+        # order (adds for refs nested in a container always reach the
+        # owner before the container's own unborrow, so the owner can
+        # never free the container — and with it the nested containment
+        # borrows — while our nested adds are still in flight). Turns the
+        # deserialize/drop of a 10k-ref container from 10k round trips
+        # into a handful (reference: batched WaitForRefRemoved pubsub).
+        self._borrow_q: collections.deque = collections.deque()
+        self._borrow_wake = threading.Event()
+        # serializes flushers: concurrent drains could split one owner's
+        # add/remove pair across two in-flight RPCs and reorder them
+        self._borrow_flush_lock = threading.Lock()
+        self._borrow_thread = threading.Thread(
+            target=self._borrow_flush_loop, daemon=True,
+            name=f"{role}-borrow")
+        self._borrow_thread.start()
 
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name="lease-reaper")
@@ -661,13 +679,7 @@ class ClusterBackend:
             first = ref.id() not in self._borrowed_owner
             self._borrowed_owner[ref.id()] = ref.owner_id()
         if first:
-            try:
-                self.object_plane.owner_client(ref.owner_id()).call(
-                    "add_borrower", {
-                        "object_id": ref.id().binary(),
-                        "borrower": self.worker.worker_id.binary()})
-            except Exception:
-                pass
+            self._enqueue_borrow("add", ref.owner_id(), ref.id())
         self.worker.refcounter.on_ref_deserialized(ref.id())
 
     def _on_ref_removed(self, oid: ObjectID) -> None:
@@ -679,13 +691,73 @@ class ClusterBackend:
         self.object_plane.release_local_pin(oid)
         if owner is None:
             return
-        try:
-            self.object_plane.owner_client(owner).call(
-                "remove_borrower", {
-                    "object_id": oid.binary(),
-                    "borrower": self.worker.worker_id.binary()})
-        except Exception:
-            pass
+        self._enqueue_borrow("remove", owner, oid)
+
+    # -------------------------------------------------------- borrow batching
+
+    def _enqueue_borrow(self, kind: str, owner: WorkerID,
+                        oid: ObjectID) -> None:
+        self._borrow_q.append((kind, owner.binary(), oid.binary()))
+        if len(self._borrow_q) >= 200:
+            self._borrow_wake.set()
+
+    def _borrow_flush_loop(self) -> None:
+        # 200ms idle cadence: borrow traffic is advisory bookkeeping whose
+        # only cost-of-delay is deferred frees, and a 5ms timer measurably
+        # taxed single-CPU hosts with GIL handoffs (~20% on the hot-path
+        # microbenches). Bursts don't wait: _enqueue_borrow sets the event
+        # at >=200 queued, so big batches flush immediately.
+        while not self._closed:
+            self._borrow_wake.wait(timeout=0.2)
+            self._borrow_wake.clear()
+            self.flush_borrows()
+
+    def flush_borrows(self) -> None:
+        """Drain the borrow queue and notify owners, one batched RPC per
+        owner. Called by the flush loop, by shutdown, and by worker_main
+        BEFORE every task reply: the reply releases the submitter's
+        serialize-time pins, so our adds for borrowed args must be at
+        their owners first (transfer-before-release, reply side)."""
+        # Lock BEFORE the emptiness check: a caller that needs the
+        # adds-before-reply guarantee must also wait out a drain the
+        # background loop already popped and is mid-RPC on — an empty
+        # queue alone doesn't mean the adds have landed.
+        with self._borrow_flush_lock:
+            if not self._borrow_q:
+                return
+            batch = []
+            while self._borrow_q:
+                batch.append(self._borrow_q.popleft())
+            # Send every add before any remove. Within one drain a remove
+            # to owner O2 (e.g. dropping a container) can transitively
+            # release protection for a ref whose add targets a DIFFERENT
+            # owner O1, so per-owner FIFO alone is not enough — the
+            # protect/release phases must be globally ordered. Across
+            # drains FIFO holds already: drains are serialized by this
+            # lock, and an add enqueued after a remove may legitimately
+            # be sent after it.
+            me = self.worker.worker_id.binary()
+            for phase in ("add", "remove"):
+                by_owner: Dict[bytes, list] = {}
+                for kind, owner, oid in batch:
+                    if kind == phase:
+                        by_owner.setdefault(owner, []).append((kind, oid))
+                for owner, ops in by_owner.items():
+                    try:
+                        self.object_plane.owner_client(WorkerID(owner)).call(
+                            "borrow_batch", {"borrower": me, "ops": ops})
+                    except Exception:  # noqa: BLE001 — owner gone: refs
+                        pass           # resolve to ObjectLost on use
+
+    def _h_borrow_batch(self, p, ctx):
+        borrower = p["borrower"]
+        for kind, oid in p["ops"]:
+            if kind == "add":
+                self.worker.refcounter.add_borrower(ObjectID(oid), borrower)
+            else:
+                self.worker.refcounter.remove_borrower(ObjectID(oid),
+                                                       borrower)
+        return True
 
     # --------------------------------------------------------------- objects
 
@@ -1028,6 +1100,13 @@ class ClusterBackend:
             return
         self._closed = True
         self._flush_telemetry()  # last-interval metrics/spans must land
+        # stop the flush loop before the final drain: a concurrent drain
+        # could split one owner's add/remove pair across two in-flight
+        # RPCs; after the join, any late enqueue from teardown is caught
+        # by this (locked) final flush
+        self._borrow_wake.set()
+        self._borrow_thread.join(timeout=2.0)
+        self.flush_borrows()     # queued unborrows must reach owners
         with self._lock:
             subs = list(self._submitters.values())
         for sub in subs:

@@ -367,6 +367,10 @@ class Executor:
 
     def _reply_error(self, payload: dict, ctx, exc: BaseException,
                      t_start: float) -> None:
+        # any reply releases the submitter's serialize-time arg pins, so
+        # our queued add-borrower registrations for those args must reach
+        # their owners first (transfer-before-release, borrower side)
+        self.backend.flush_borrows()
         self._record_span(payload, t_start, ok=False)
         so = serialization.serialize_error(exc)
         n = max(1, payload["num_returns"])
@@ -379,6 +383,7 @@ class Executor:
 
     def _reply_ok(self, payload: dict, ctx, result: Any,
                   t_start: float) -> None:
+        self.backend.flush_borrows()  # see _reply_error: adds-before-reply
         num_returns = payload["num_returns"]
         if num_returns == 1:
             values = [result]
@@ -443,6 +448,7 @@ class Executor:
             # same transfer-before-release as _reply_ok
             if caller and r.owner_id() == self.worker.worker_id:
                 self.worker.refcounter.add_borrower(r.id(), caller)
+        self.backend.flush_borrows()  # adds-before-ship for borrowed refs
         owner_client.oneway("stream_item", msg)
         for r in so.contained_refs:
             self.worker.refcounter.on_serialized_ref_done(r.id())
@@ -461,10 +467,12 @@ class Executor:
         except BaseException as e:  # noqa: BLE001
             self._record_span(payload, t_start, ok=False)
             so = serialization.serialize_error(e)
+            self.backend.flush_borrows()  # adds-before-reply
             ctx.reply({"streaming_count": i,
                        "streaming_error": so.to_bytes()})
             return
         self._record_span(payload, t_start, ok=True)
+        self.backend.flush_borrows()  # see _reply_error: adds-before-reply
         ctx.reply({"streaming_count": i})
 
     # ---------------------------------------------------------- async actors
@@ -480,6 +488,7 @@ class Executor:
             """Reply for a streaming call, preserving the count of items
             already shipped so the consumer drains them before seeing the
             error (same contract as the sync _stream_out path)."""
+            self.backend.flush_borrows()  # adds-before-reply
             if exc is None:
                 self._record_span(payload, t_start, ok=True)
                 ctx.reply({"streaming_count": i})

@@ -133,7 +133,7 @@ def cmd_list(args) -> int:
                 continue
             try:
                 st = _client(n["address"]).call("store_stats")
-                rows.append({"node_id": n["node_id"], "store": st})
+                rows.append({"node_id": n["node_id"], **st})
             except Exception:
                 pass
     elif args.what == "tasks":
