@@ -22,11 +22,13 @@ from ray_tpu.serve.controller import (CONTROLLER_NAME, SERVE_NAMESPACE,
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import multiplexed, get_multiplexed_model_id
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "delete", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "start_http_proxy", "batch",
+    "multiplexed", "get_multiplexed_model_id",
 ]
 
 

@@ -12,6 +12,15 @@ The batcher is its own daemon thread (the reference uses an asyncio task),
 so no request lane is ever parked leading a batch and the caller that
 triggered a batch gets its reply as soon as that batch finishes.
 
+Composition with model multiplexing: requests tagged with different
+``multiplexed_model_id``s must never coalesce into one invocation (the
+batched function serves ONE model per call), so queues are partitioned by
+the caller's model id — captured on the request thread at submit time —
+and the batcher thread re-publishes that id so
+``serve.get_multiplexed_model_id()`` works INSIDE the batched function.
+Model-partitioned queues expire after an idle period so a stream of
+distinct model ids doesn't accumulate batcher threads.
+
     class Model:
         @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.01)
         def predict(self, inputs: list):   # list in -> list out
@@ -25,26 +34,40 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                     _set_request_model_id)
+
+#: model-partitioned queues exit their batcher thread after this long
+#: with no traffic (the default ""-model queue is permanent)
+IDLE_EXPIRE_S = 60.0
+
 
 class _BatchQueue:
     def __init__(self, fn: Callable, owner: Any, max_batch_size: int,
-                 batch_wait_timeout_s: float):
+                 batch_wait_timeout_s: float, model_id: str = "",
+                 on_expire: Optional[Callable[[], None]] = None):
         self.fn = fn
         self.owner = owner
         self.max_batch_size = max_batch_size
         self.timeout = batch_wait_timeout_s
+        self.model_id = model_id
+        self._on_expire = on_expire
+        self.dead = False
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.items: List[dict] = []
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
-            name=f"serve-batch-{getattr(fn, '__name__', 'fn')}")
+            name=f"serve-batch-{getattr(fn, '__name__', 'fn')}"
+                 f"{'-' + model_id if model_id else ''}")
         self._thread.start()
 
     def submit(self, value: Any) -> Any:
         entry = {"value": value, "done": threading.Event(),
                  "result": None, "error": None}
         with self.lock:
+            if self.dead:
+                raise _QueueExpired()
             self.items.append(entry)
             self.cv.notify_all()
         entry["done"].wait()
@@ -55,10 +78,25 @@ class _BatchQueue:
         return entry["result"]
 
     def _loop(self) -> None:
+        expirable = self._on_expire is not None
         while True:
             with self.lock:
+                idle_since = time.monotonic()
                 while not self.items:
-                    self.cv.wait()
+                    if expirable:
+                        self.cv.wait(timeout=IDLE_EXPIRE_S / 4)
+                        if not self.items and \
+                                time.monotonic() - idle_since > IDLE_EXPIRE_S:
+                            # marked dead under OUR lock: a concurrent
+                            # submit either already enqueued (we'd see
+                            # items and keep running) or will see dead
+                            # and recreate through the registry
+                            self.dead = True
+                            break
+                    else:
+                        self.cv.wait()
+                if self.dead:
+                    break
                 deadline = time.monotonic() + self.timeout
                 while len(self.items) < self.max_batch_size:
                     remaining = deadline - time.monotonic()
@@ -68,9 +106,14 @@ class _BatchQueue:
                 batch = self.items[:self.max_batch_size]
                 self.items = self.items[self.max_batch_size:]
             self._run(batch)
+        if self._on_expire is not None:
+            self._on_expire()
 
     def _run(self, batch: List[dict]) -> None:
         try:
+            # the batched fn runs on THIS thread — re-publish the batch's
+            # model id so get_multiplexed_model_id() works inside it
+            _set_request_model_id(self.model_id)
             inputs = [e["value"] for e in batch]
             results = self.fn(self.owner, inputs) \
                 if self.owner is not None else self.fn(inputs)
@@ -89,39 +132,73 @@ class _BatchQueue:
                 e["done"].set()
 
 
+class _QueueExpired(Exception):
+    """Internal: submit raced an idle expiry; retry through the registry."""
+
+
 _CREATE_LOCK = threading.Lock()
-#: plain-function queues by qualname (functions don't churn; instances
-#: store their queue as an attribute so it dies with the instance —
-#: a global id(owner)-keyed registry would leak AND could hand a new
-#: instance a dead one's queue after id reuse)
+#: plain-function queue maps by (module, qualname) — functions don't
+#: churn; instances store their queue map as an attribute so it dies with
+#: the instance (a global id(owner)-keyed registry would leak AND could
+#: hand a new instance a dead one's queue after id reuse). Each map is
+#: model_id -> _BatchQueue.
 _FUNC_QUEUES: dict = {}
 
 
-def _method_queue(fn: Callable, owner: Any, max_batch_size: int,
-                  timeout_s: float) -> _BatchQueue:
-    attr = f"__rtpu_batchq_{getattr(fn, '__name__', 'fn')}"
-    q = getattr(owner, attr, None)
-    if q is None:
-        with _CREATE_LOCK:
-            q = getattr(owner, attr, None)
-            if q is None:
-                q = _BatchQueue(fn, owner, max_batch_size, timeout_s)
-                setattr(owner, attr, q)
+def _get_queue(qmap: dict, fn: Callable, owner: Any, max_batch_size: int,
+               timeout_s: float, model_id: str) -> _BatchQueue:
+    """Look up / create the queue for one model id inside a queue map.
+    Caller must hold _CREATE_LOCK."""
+    q = qmap.get(model_id)
+    if q is None or q.dead:
+        def expire(mid=model_id):
+            with _CREATE_LOCK:
+                if qmap.get(mid) is not None and qmap[mid].dead:
+                    del qmap[mid]
+        q = _BatchQueue(fn, owner, max_batch_size, timeout_s,
+                        model_id=model_id,
+                        on_expire=expire if model_id else None)
+        qmap[model_id] = q
     return q
 
 
+def _method_queue(fn: Callable, owner: Any, max_batch_size: int,
+                  timeout_s: float, model_id: str) -> _BatchQueue:
+    attr = f"__rtpu_batchq_{getattr(fn, '__name__', 'fn')}"
+    # lock-free fast path (double-checked): the global _CREATE_LOCK is
+    # only for creation/replacement, never the per-request hot path
+    qmap = getattr(owner, attr, None)
+    if qmap is not None:
+        q = qmap.get(model_id)
+        if q is not None and not q.dead:
+            return q
+    with _CREATE_LOCK:
+        qmap = getattr(owner, attr, None)
+        if qmap is None:
+            qmap = {}
+            setattr(owner, attr, qmap)
+        return _get_queue(qmap, fn, owner, max_batch_size, timeout_s,
+                          model_id)
+
+
 def _func_queue(fn: Callable, max_batch_size: int,
-                timeout_s: float) -> _BatchQueue:
+                timeout_s: float, model_id: str) -> _BatchQueue:
     # module + qualname: qualname alone collides across modules and
     # would route the second function's calls into the first's queue
     key = (getattr(fn, "__module__", ""),
            getattr(fn, "__qualname__", repr(fn)))
+    qmap = _FUNC_QUEUES.get(key)
+    if qmap is not None:
+        q = qmap.get(model_id)
+        if q is not None and not q.dead:
+            return q
     with _CREATE_LOCK:
-        q = _FUNC_QUEUES.get(key)
-        if q is None:
-            q = _BatchQueue(fn, None, max_batch_size, timeout_s)
-            _FUNC_QUEUES[key] = q
-        return q
+        qmap = _FUNC_QUEUES.get(key)
+        if qmap is None:
+            qmap = {}
+            _FUNC_QUEUES[key] = qmap
+        return _get_queue(qmap, fn, None, max_batch_size, timeout_s,
+                          model_id)
 
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
@@ -135,13 +212,25 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
 
         @functools.wraps(fn)
         def method(self, value):
-            return _method_queue(fn, self, max_batch_size,
-                                 batch_wait_timeout_s).submit(value)
+            mid = get_multiplexed_model_id()
+            while True:
+                try:
+                    return _method_queue(fn, self, max_batch_size,
+                                         batch_wait_timeout_s,
+                                         mid).submit(value)
+                except _QueueExpired:
+                    continue  # raced idle expiry; registry recreates
 
         @functools.wraps(fn)
         def func(value):
-            return _func_queue(fn, max_batch_size,
-                               batch_wait_timeout_s).submit(value)
+            mid = get_multiplexed_model_id()
+            while True:
+                try:
+                    return _func_queue(fn, max_batch_size,
+                                       batch_wait_timeout_s,
+                                       mid).submit(value)
+                except _QueueExpired:
+                    continue
 
         params = list(inspect.signature(fn).parameters)
         is_method = params and params[0] == "self"

@@ -1,0 +1,206 @@
+"""Model multiplexing — many models served by one deployment's replicas.
+
+Role-equivalent to the reference's multiplexed-serving surface
+(reference: serve/multiplex.py `_ModelMultiplexWrapper`,
+serve/api.py `multiplexed`, handle option `multiplexed_model_id`, LLM
+LoRA multiplexing in llm/_internal/serve/deployments/llm/multiplex/):
+a replica lazily loads models by id through a user-supplied load
+function, keeps at most ``max_num_models_per_replica`` of them in an
+LRU cache, and the router prefers replicas that already hold the
+requested model so repeated traffic for one model stays hot.
+
+Design divergence from the reference: the reference pushes each
+replica's loaded-model set to the controller on a timer and the router
+reads it from there. Here the router LEARNS locality from its own
+routing decisions — the replica it sends model m to is, from that
+moment, a replica that holds m (the wrapper loads on first use). That
+removes the push loop and its staleness window at the cost of
+router-local knowledge; a cold router simply re-establishes affinity
+with its first request per model. Eviction on the replica is likewise
+discovered lazily (a request routed to a replica that evicted m just
+reloads it there).
+"""
+
+from __future__ import annotations
+
+import collections
+import inspect
+import threading
+from typing import Any, Callable, Optional
+
+# Reserved kwarg the router uses to ship the request's model id to the
+# replica; stripped by Replica.handle_request before the user callable
+# runs (the reference threads this through its RequestMetadata proto).
+MUX_KWARG = "__serve_multiplexed_model_id__"
+
+_request_ctx = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id the in-flight request was tagged with via
+    ``handle.options(multiplexed_model_id=...)`` — readable anywhere in
+    the replica's request path (reference: serve.get_multiplexed_model_id).
+    Empty string when the request carried no tag."""
+    return getattr(_request_ctx, "model_id", "")
+
+
+def _set_request_model_id(model_id: str) -> None:
+    _request_ctx.model_id = model_id
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models (reference:
+    serve/multiplex.py _ModelMultiplexWrapper.models OrderedDict)."""
+
+    def __init__(self, load_fn: Callable[..., Any], max_models: int,
+                 self_arg: Optional[Any] = None):
+        self._load = load_fn
+        self._self_arg = self_arg
+        self._max = max_models
+        self._lock = threading.Lock()
+        self._models: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self.load_count = 0
+        self.evict_count = 0
+
+    def model_ids(self) -> list:
+        with self._lock:
+            return list(self._models.keys())
+
+    def get_model(self, model_id: str) -> Any:
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        # load OUTSIDE the cache lock: model loads are seconds-long and
+        # must not serialize unrelated cache hits. A racing duplicate
+        # load of the same id resolves FIRST-writer-wins: earlier callers
+        # already hold the first copy, so the duplicate is the one torn
+        # down (silently dropping either copy would leak accelerator
+        # memory that only an unload() hook can free).
+        if self._self_arg is not None:
+            model = self._load(self._self_arg, model_id)
+        else:
+            model = self._load(model_id)
+        discard = []
+        with self._lock:
+            existing = self._models.get(model_id)
+            if existing is not None:
+                discard.append(model)   # we lost the race; serve theirs
+                model = existing
+                self._models.move_to_end(model_id)
+            else:
+                self._models[model_id] = model
+                self._models.move_to_end(model_id)
+                self.load_count += 1
+                while self._max > 0 and len(self._models) > self._max:
+                    _evicted_id, evicted = self._models.popitem(last=False)
+                    self.evict_count += 1
+                    discard.append(evicted)
+        for dead in discard:
+            # Eager teardown so accelerator memory frees NOW, not at the
+            # next gc cycle. An ``unload()`` hook is preferred — it can
+            # be idempotent; falling back to the reference's explicit
+            # __del__ call means non-idempotent __del__ teardown runs
+            # again at refcount-zero, so models using __del__ should
+            # tolerate a second call.
+            teardown = getattr(dead, "unload", None) \
+                or getattr(dead, "__del__", None)
+            if callable(teardown):
+                try:
+                    teardown()
+                except Exception:  # noqa: BLE001 — user teardown
+                    pass
+        return model
+
+
+class _MultiplexedDescriptor:
+    """Decorator product. Works both as a plain function wrapper and as
+    a method descriptor: accessing it on a deployment instance binds a
+    per-instance cache (one replica process hosts one instance, so this
+    is the per-replica cache)."""
+
+    def __init__(self, load_fn: Callable[..., Any], max_models: int):
+        self._load_fn = load_fn
+        self._max = max_models
+        self._is_method = "self" in inspect.signature(load_fn).parameters
+        self._free_cache: Optional[_ModelCache] = None
+        self._lock = threading.Lock()
+        self.__name__ = getattr(load_fn, "__name__", "multiplexed")
+        self.__doc__ = getattr(load_fn, "__doc__", None)
+        # per-instance caches live in the INSTANCE's __dict__ under this
+        # key, so their lifetime (and that of every loaded model) is the
+        # instance's — a descriptor-side registry would pin instances and
+        # multi-GB models for the process lifetime
+        self._inst_key = f"__mux_cache_{self.__name__}__"
+
+    def __reduce__(self):
+        # ship only the load function + config; caches (and their locks)
+        # are per-process state that must start empty on the replica
+        return (_rebuild_multiplexed, (self._load_fn, self._max))
+
+    def __get__(self, instance, owner=None):
+        if instance is None:
+            return self
+        cache = instance.__dict__.get(self._inst_key)
+        if cache is None:
+            with self._lock:
+                cache = instance.__dict__.get(self._inst_key)
+                if cache is None:
+                    cache = _ModelCache(self._load_fn, self._max,
+                                        self_arg=instance)
+                    instance.__dict__[self._inst_key] = cache
+
+        def bound(model_id: str) -> Any:
+            return cache.get_model(model_id)
+        bound.cache = cache  # tests/observability: loads, evictions, ids
+        return bound
+
+    def _free(self) -> _ModelCache:
+        with self._lock:
+            if self._free_cache is None:
+                self._free_cache = _ModelCache(self._load_fn, self._max)
+            return self._free_cache
+
+    def __call__(self, model_id: str) -> Any:
+        if self._is_method:
+            raise TypeError(
+                "multiplexed load function with a 'self' parameter must "
+                "be called through its deployment instance")
+        return self._free().get_model(model_id)
+
+    @property
+    def cache(self) -> _ModelCache:
+        return self._free()
+
+
+def _rebuild_multiplexed(load_fn: Callable,
+                         max_models: int) -> "_MultiplexedDescriptor":
+    return _MultiplexedDescriptor(load_fn, max_models)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for a model-load function/method: calls become LRU-cached
+    by model id, bounded per replica (reference: serve/api.py
+    `@serve.multiplexed(max_num_models_per_replica=...)`).
+
+        @serve.deployment
+        class ModelServer:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def load(self, model_id: str):
+                return heavy_load(model_id)
+
+            def __call__(self, body):
+                model = self.load(serve.get_multiplexed_model_id())
+                ...
+    """
+    if max_num_models_per_replica == 0 or max_num_models_per_replica < -1:
+        raise ValueError("max_num_models_per_replica must be positive "
+                         "or -1 (unbounded)")
+
+    def wrap(fn: Callable) -> _MultiplexedDescriptor:
+        return _MultiplexedDescriptor(fn, max_num_models_per_replica)
+    if func is not None:
+        return wrap(func)
+    return wrap

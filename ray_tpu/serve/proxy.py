@@ -74,11 +74,20 @@ class HTTPProxy:
                 except AttributeError:
                     self._reply(404, {"error": f"no method {method!r}"})
                     return
+                # model-aware routing tag (reference: proxy reads the
+                # serve_multiplexed_model_id header into RequestMetadata)
+                mux_id = self.headers.get(
+                    "serve_multiplexed_model_id", "") or ""
                 try:
                     if stream:
-                        gen = handle.options(stream=True).remote(body)
+                        gen = handle.options(
+                            stream=True,
+                            multiplexed_model_id=mux_id).remote(body)
                         self._reply_sse(gen)
                         return
+                    if mux_id:
+                        handle = handle.options(
+                            multiplexed_model_id=mux_id)
                     if body is None:
                         resp = handle.remote()
                     else:

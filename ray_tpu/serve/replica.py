@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional, Tuple
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.serve.multiplex import MUX_KWARG, _set_request_model_id
 
 
 class Replica:
@@ -43,6 +44,7 @@ class Replica:
                        kwargs: Dict[str, Any]) -> Any:
         """One request. Runs on one of the replica actor's concurrency
         threads (max_ongoing_requests maps to actor max_concurrency)."""
+        _set_request_model_id(kwargs.pop(MUX_KWARG, ""))
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -67,6 +69,8 @@ class Replica:
         the caller consumes items while the request is still running
         (reference: replica.py streaming responses over the generator
         protocol)."""
+        model_id = kwargs.pop(MUX_KWARG, "")
+        _set_request_model_id(model_id)
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -88,7 +92,16 @@ class Replica:
                     f"streaming call to {self.deployment_name}."
                     f"{method_name} returned {type(out).__name__}, "
                     f"expected a generator/iterable of items")
-            for item in out:
+            it = iter(out)
+            while True:
+                # a lazy generator body runs during next(), and another
+                # request may have run on this thread between our yields
+                # — re-assert the request's model id each pull
+                _set_request_model_id(model_id)
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
                 yield item
         finally:
             with self._lock:
@@ -100,10 +113,27 @@ class Replica:
     @ray_tpu.method(concurrency_group="control")
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {"replica_id": self.replica_id,
-                    "ongoing": self._ongoing,
-                    "total": self._total,
-                    "uptime_s": time.time() - self._started}
+            out = {"replica_id": self.replica_id,
+                   "ongoing": self._ongoing,
+                   "total": self._total,
+                   "uptime_s": time.time() - self._started}
+        mux = self._multiplexed_model_ids()
+        if mux is not None:
+            out["multiplexed_model_ids"] = mux
+        return out
+
+    def _multiplexed_model_ids(self):
+        """Loaded-model ids across any @serve.multiplexed members of the
+        deployment (reference: MultiplexedReplicaInfo pushed to the
+        controller; here surfaced via stats for observability/tests)."""
+        from ray_tpu.serve.multiplex import _MultiplexedDescriptor
+        cls = type(self.callable)
+        found = None
+        for name in dir(cls):
+            if isinstance(getattr(cls, name, None), _MultiplexedDescriptor):
+                bound = getattr(self.callable, name)
+                found = (found or []) + bound.cache.model_ids()
+        return found
 
     @ray_tpu.method(concurrency_group="control")
     def health_check(self) -> bool:

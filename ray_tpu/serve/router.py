@@ -19,6 +19,7 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.multiplex import MUX_KWARG
 
 
 class DeploymentResponse:
@@ -89,6 +90,10 @@ class DeploymentResponseGenerator:
 
 class Router:
     TABLE_MAX_AGE_S = 2.0
+    # forget a model->replica affinity not re-confirmed within this window
+    # (the replica has likely LRU-evicted the model by then anyway)
+    MUX_AFFINITY_TTL_S = 120.0
+    MUX_MAX_REPLICAS_PER_MODEL = 8
 
     def __init__(self, controller, deployment_name: str):
         self._controller = controller
@@ -101,6 +106,10 @@ class Router:
         self._pending: list = []             # [(key, ref)] awaiting completion
         self._pending_cv = threading.Condition(self._lock)
         self._reaper_started = False
+        # multiplex locality, learned from our own routing decisions (see
+        # serve/multiplex.py module docstring): model_id -> {replica key
+        # -> last routed-at timestamp}
+        self._mux_affinity: Dict[str, Dict[str, float]] = {}
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -113,6 +122,17 @@ class Router:
             self._controller.get_routing_table.remote(self._name),
             timeout=30)
         with self._lock:
+            # sweep expired multiplex affinities so a long-lived router
+            # serving a stream of distinct model ids doesn't grow
+            # per-model entries forever (entries are also capacity-capped
+            # per model in _pick)
+            cutoff = time.monotonic() - self.MUX_AFFINITY_TTL_S
+            for mid in list(self._mux_affinity):
+                seen = self._mux_affinity[mid]
+                for k in [k for k, ts in seen.items() if ts < cutoff]:
+                    del seen[k]
+                if not seen:
+                    del self._mux_affinity[mid]
             if table["version"] != self._version:
                 self._replicas = table["replicas"]
                 self._version = table["version"]
@@ -127,26 +147,61 @@ class Router:
                                  if k in live]
             self._fetched_at = now
 
-    def _pick(self):
+    # a model-holding replica is preferred until its queue exceeds the
+    # best alternative's by this much — then the model spills to a new
+    # replica (which loads it), scaling a hot model out instead of
+    # melting one replica while the rest idle
+    MUX_SPILL_SLACK = 4
+
+    def _pick_pow2(self, pool):
+        if len(pool) == 1:
+            return pool[0]
+        a, b = random.sample(pool, 2)
+        qa = self._inflight.get(a.actor_id.hex(), 0)
+        qb = self._inflight.get(b.actor_id.hex(), 0)
+        return a if qa <= qb else b
+
+    def _pick(self, model_id: str = ""):
         with self._lock:
             if not self._replicas:
                 return None
-            if len(self._replicas) == 1:
-                return self._replicas[0]
-            a, b = random.sample(self._replicas, 2)
-            qa = self._inflight.get(a.actor_id.hex(), 0)
-            qb = self._inflight.get(b.actor_id.hex(), 0)
-            return a if qa <= qb else b
+            chosen = self._pick_pow2(self._replicas)
+            if model_id:
+                # Prefer replicas that already hold the model (reference:
+                # pow-2 scheduler's multiplexed candidate preference) —
+                # a PREFERENCE, not a hard filter: when the best model-
+                # holding replica is overloaded relative to the general
+                # pow-2 pick, route there instead and let that replica
+                # become a new home for the model.
+                seen = self._mux_affinity.get(model_id)
+                if seen:
+                    now = time.monotonic()
+                    warm = [h for h in self._replicas
+                            if now - seen.get(h.actor_id.hex(),
+                                              -1e9) < self.MUX_AFFINITY_TTL_S]
+                    if warm:
+                        best_warm = self._pick_pow2(warm)
+                        qw = self._inflight.get(best_warm.actor_id.hex(), 0)
+                        qc = self._inflight.get(chosen.actor_id.hex(), 0)
+                        if qw <= qc + self.MUX_SPILL_SLACK:
+                            chosen = best_warm
+                seen = self._mux_affinity.setdefault(model_id, {})
+                seen[chosen.actor_id.hex()] = time.monotonic()
+                while len(seen) > self.MUX_MAX_REPLICAS_PER_MODEL:
+                    seen.pop(min(seen, key=seen.get))
+            return chosen
 
-    def route_streaming(self, method_name: str, args: tuple,
-                        kwargs: dict) -> DeploymentResponseGenerator:
+    def route_streaming(self, method_name: str, args: tuple, kwargs: dict,
+                        model_id: str = "") -> DeploymentResponseGenerator:
         """Streamed call: items become consumable as the replica yields
         them (rides num_returns='streaming' actor methods)."""
+        if model_id:
+            kwargs = {**kwargs, MUX_KWARG: model_id}
         self._refresh()
-        replica = self._pick()
+        replica = self._pick(model_id)
         if replica is None:
             self._refresh(force=True)
-            replica = self._pick()
+            replica = self._pick(model_id)
             if replica is None:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no live replicas")
@@ -165,22 +220,25 @@ class Router:
             raise
         return DeploymentResponseGenerator(gen, done)
 
-    def route(self, method_name: str, args: tuple,
-              kwargs: dict) -> DeploymentResponse:
-        ref = self._submit(method_name, args, kwargs)
+    def route(self, method_name: str, args: tuple, kwargs: dict,
+              model_id: str = "") -> DeploymentResponse:
+        ref = self._submit(method_name, args, kwargs, model_id)
 
         def retry():
             # replica died before replying: refetch the table and resubmit
             self._refresh(force=True)
-            return self._submit(method_name, args, kwargs)
+            return self._submit(method_name, args, kwargs, model_id)
         return DeploymentResponse(ref, retry=retry)
 
-    def _submit(self, method_name: str, args: tuple, kwargs: dict):
+    def _submit(self, method_name: str, args: tuple, kwargs: dict,
+                model_id: str = ""):
+        if model_id:
+            kwargs = {**kwargs, MUX_KWARG: model_id}
         self._refresh()
-        replica = self._pick()
+        replica = self._pick(model_id)
         if replica is None:
             self._refresh(force=True)
-            replica = self._pick()
+            replica = self._pick(model_id)
             if replica is None:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no live replicas")
@@ -242,38 +300,55 @@ class DeploymentHandle:
     ``h.method.remote(...)`` calls a named method."""
 
     def __init__(self, controller, deployment_name: str,
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
         self._controller = controller
         self._name = deployment_name
         self._method = method_name
         self._stream = stream
+        self._model_id = multiplexed_model_id
         self._router = Router(controller, deployment_name)
 
-    def options(self, stream: bool = False) -> "DeploymentHandle":
+    def options(self, stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
         """handle.options(stream=True).remote(...) iterates the
         deployment method's yielded items as they are produced
-        (reference: serve handle options(stream=True))."""
-        h = DeploymentHandle(self._controller, self._name,
-                             method_name=self._method, stream=stream)
+        (reference: serve handle options(stream=True));
+        options(multiplexed_model_id="m").remote(...) tags the request
+        for model-aware routing + serve.get_multiplexed_model_id()
+        (reference: handle option multiplexed_model_id). Fields not
+        passed inherit from this handle, so chained options() calls
+        compose instead of silently resetting each other."""
+        h = DeploymentHandle(
+            self._controller, self._name, method_name=self._method,
+            stream=self._stream if stream is None else stream,
+            multiplexed_model_id=(self._model_id
+                                  if multiplexed_model_id is None
+                                  else multiplexed_model_id))
         h._router = self._router
         return h
 
     def remote(self, *args, **kwargs):
         if self._stream:
-            return self._router.route_streaming(self._method, args, kwargs)
-        return self._router.route(self._method, args, kwargs)
+            return self._router.route_streaming(self._method, args, kwargs,
+                                                self._model_id)
+        return self._router.route(self._method, args, kwargs,
+                                  self._model_id)
 
     def __getattr__(self, item: str) -> "DeploymentHandle":
         if item.startswith("_"):
             raise AttributeError(item)
         h = DeploymentHandle(self._controller, self._name, method_name=item,
-                             stream=self._stream)
+                             stream=self._stream,
+                             multiplexed_model_id=self._model_id)
         h._router = self._router  # share in-flight state across methods
         return h
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._name, self._method, self._stream))
+                (self._controller, self._name, self._method, self._stream,
+                 self._model_id))
 
     # Handles are value-equal by target: deploy() compares old vs new
     # init_args to decide whether a redeploy must restart replicas, and a

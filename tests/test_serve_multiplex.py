@@ -1,0 +1,194 @@
+"""Serve model multiplexing: LRU model cache per replica, request
+tagging, and model-aware routing (reference: serve/multiplex.py,
+handle option multiplexed_model_id, pow-2 scheduler candidate
+preference for multiplexed requests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.multiplex import multiplexed, _MultiplexedDescriptor
+
+
+@pytest.fixture(scope="module")
+def serve_rt():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------- unit: cache
+
+class _Loader:
+    """Plain object standing in for a deployment instance."""
+
+    def __init__(self):
+        self.loads = []
+
+    @multiplexed(max_num_models_per_replica=2)
+    def load(self, model_id: str):
+        self.loads.append(model_id)
+        return {"id": model_id}
+
+
+def test_multiplexed_lru_eviction():
+    host = _Loader()
+    assert host.load("a")["id"] == "a"
+    assert host.load("b")["id"] == "b"
+    assert host.load("a")["id"] == "a"      # hit — no reload
+    assert host.loads == ["a", "b"]
+    host.load("c")                          # evicts LRU = "b"
+    assert host.load("a")["id"] == "a"      # still cached
+    assert host.loads == ["a", "b", "c"]
+    host.load("b")                          # reload after eviction
+    assert host.loads == ["a", "b", "c", "b"]
+    assert set(host.load.cache.model_ids()) == {"a", "b"}
+    assert host.load.cache.evict_count == 2
+
+
+def test_multiplexed_plain_function():
+    calls = []
+
+    @multiplexed(max_num_models_per_replica=1)
+    def load(model_id: str):
+        calls.append(model_id)
+        return model_id.upper()
+
+    assert load("x") == "X"
+    assert load("x") == "X"
+    assert calls == ["x"]
+    load("y")                               # evicts x (max=1)
+    load("x")
+    assert calls == ["x", "y", "x"]
+
+
+def test_multiplexed_eager_teardown():
+    died = []
+
+    class Model:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def __del__(self):
+            died.append(self.mid)
+
+    @multiplexed(max_num_models_per_replica=1)
+    def load(model_id: str):
+        return Model(model_id)
+
+    m1 = load("one")
+    load("two")
+    # eviction of "one" calls its __del__ eagerly even while we still
+    # hold m1 (reference behavior: free accelerator memory NOW)
+    assert "one" in died
+    del m1
+
+
+def test_multiplexed_rejects_bad_config():
+    with pytest.raises(ValueError):
+        multiplexed(max_num_models_per_replica=0)(lambda mid: mid)
+
+
+# ------------------------------------------------- cluster: serve routing
+
+@serve.deployment(num_replicas=2)
+class MuxServer:
+    def __init__(self):
+        # worker id is unique per replica process — a usable replica tag
+        self.replica_tag = ray_tpu.get_runtime_context().worker_id.hex()[:8]
+
+    @serve.multiplexed(max_num_models_per_replica=2)
+    def load(self, model_id: str):
+        return {"model": model_id, "loaded_on": self.replica_tag}
+
+    def __call__(self, body):
+        mid = serve.get_multiplexed_model_id()
+        model = self.load(mid)
+        return {"model_id": mid, "replica": self.replica_tag,
+                "loaded_on": model["loaded_on"]}
+
+
+def test_multiplex_routing_affinity(serve_rt):
+    handle = serve.run(MuxServer.bind())
+    # first touch establishes each model's home replica
+    homes = {}
+    for mid in ("m1", "m2"):
+        out = handle.options(multiplexed_model_id=mid).remote(mid) \
+            .result(timeout=60)
+        assert out["model_id"] == mid
+        homes[mid] = out["replica"]
+    # repeated traffic for a model sticks to its home replica
+    for _ in range(6):
+        for mid in ("m1", "m2"):
+            out = handle.options(multiplexed_model_id=mid).remote(mid) \
+                .result(timeout=60)
+            assert out["replica"] == homes[mid], \
+                f"{mid} moved from {homes[mid]} to {out['replica']}"
+    # untagged requests still route (no affinity involved)
+    out = handle.remote("untagged").result(timeout=60)
+    assert out["model_id"] == ""
+
+
+def test_multiplex_model_ids_in_stats(serve_rt):
+    # the deployment from the previous test is still running
+    st = serve.status()
+    assert "MuxServer" in st
+    handle = serve.get_app_handle("MuxServer")
+    ctrl = handle._controller
+    table = ray_tpu.get(ctrl.get_routing_table.remote("MuxServer"),
+                        timeout=30)
+    ids = set()
+    for h in table["replicas"]:
+        s = ray_tpu.get(h.stats.remote(), timeout=30)
+        ids.update(s.get("multiplexed_model_ids", []))
+    assert {"m1", "m2"} <= ids
+
+
+def test_multiplex_descriptor_detected():
+    assert isinstance(
+        type(_Loader.__dict__["load"]), type) or True
+    assert isinstance(_Loader.__dict__["load"], _MultiplexedDescriptor)
+
+
+# --------------------------------------------- batching x multiplexing
+
+@serve.deployment(num_replicas=1)
+class BatchedMux:
+    @serve.multiplexed(max_num_models_per_replica=4)
+    def load(self, model_id: str):
+        return model_id.upper()
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+    def predict(self, bodies):
+        # runs on the batcher thread: the model id must still resolve,
+        # and every request in one batch shares it by construction
+        mid = serve.get_multiplexed_model_id()
+        w = self.load(mid)
+        return [{"model_id": mid, "weights": w, "n": len(bodies)}
+                for _ in bodies]
+
+    def __call__(self, body):
+        return self.predict(body)
+
+
+def test_batch_partitions_by_model_id(serve_rt):
+    handle = serve.run(BatchedMux.bind())
+    from concurrent.futures import ThreadPoolExecutor
+
+    def call(mid):
+        return handle.options(multiplexed_model_id=mid).remote({}) \
+            .result(timeout=60)
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        outs = list(ex.map(call, ["a", "b", "a", "b", "a", "b", "a", "b"]))
+    for out in outs:
+        # the batched fn saw the request's own model id — never another
+        # model's (queues are partitioned per model id)
+        assert out["weights"] == out["model_id"].upper()
+    mids = {o["model_id"] for o in outs}
+    assert mids == {"a", "b"}
+    # batching still coalesced concurrent same-model requests
+    assert any(o["n"] > 1 for o in outs), [o["n"] for o in outs]
