@@ -191,6 +191,10 @@ class Head:
         self._demand: Dict[tuple, dict] = {}
         self._node_clients = ClientPool(name="head->node")
         self._stopped = threading.Event()
+        # general topic pub/sub + the head's own cluster-event feed on it
+        # (reference: GCS pubsub node/actor channels, publisher.h:297)
+        from ray_tpu.runtime.pubsub import PubsubBroker
+        self.pubsub = PubsubBroker(epoch=self.incarnation)
         self.server = RpcServer({
             "register_node": self._h_register_node,
             "unregister_node": self._h_unregister_node,
@@ -219,6 +223,11 @@ class Head:
             "metrics_dump": self._h_metrics_dump,
             "timeline_dump": self._h_timeline_dump,
             "autoscaler_state": self._h_autoscaler_state,
+            "pubsub_publish": lambda p, c: self.pubsub.publish(
+                p["topic"], p["message"]),
+            "pubsub_poll": lambda p, c: self.pubsub.poll(
+                p["cursors"], p.get("timeout_s", 2.0)),
+            "pubsub_topics": lambda p, c: self.pubsub.topics(),
             "ping": lambda p, c: {"pong": True,
                                   "incarnation": self.incarnation},
         }, host=host, port=port, max_workers=32, name="head")
@@ -428,7 +437,8 @@ class Head:
         kill: List[bytes] = []
         with self._lock:
             known = self._nodes.get(node_id)
-            if known is None or not known.alive:
+            new_node = known is None or not known.alive
+            if new_node:
                 entry = _NodeEntry(node_id, p["address"], p["shm_name"],
                                    p["resources"])
                 self._nodes[node_id] = entry
@@ -478,6 +488,10 @@ class Head:
                     self.cluster.acquire(node_id, entry2.resources)
                 self._recovering_actors.discard(aid)
                 self._persist_dirty = True
+        if new_node:
+            self.pubsub.publish("cluster_events", {
+                "event": "node_added", "node_id": node_id,
+                "address": p["address"], "ts": time.time()})
         return {"session": self.session, "incarnation": self.incarnation,
                 "kill": kill}
 
@@ -990,6 +1004,10 @@ class Head:
                 restart = False
             self._persist_dirty = True
         self._persist_kick.set()
+        self.pubsub.publish("cluster_events", {
+            "event": "actor_restarting" if restart else "actor_dead",
+            "actor_id": actor_id.hex(), "reason": reason,
+            "ts": time.time()})
         if restart:
             self._spawn_actor(entry)
 
@@ -1004,6 +1022,9 @@ class Head:
                               if e.node_id == node_id and
                               e.state in (ALIVE, PENDING, RESTARTING)]
         self._node_clients.invalidate(node.address)
+        self.pubsub.publish("cluster_events", {
+            "event": "node_dead", "node_id": node_id, "reason": reason,
+            "ts": time.time()})
         for aid in dead_actor_ids:
             self._on_actor_worker_lost(aid, f"node {node_id} died: {reason}")
 

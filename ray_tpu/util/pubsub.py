@@ -1,0 +1,126 @@
+"""User-facing topic pub/sub over the head broker.
+
+Role-equivalent to the reference's pub/sub surface (reference:
+src/ray/pubsub/subscriber.h long-poll subscriber,
+python/ray/_private/gcs_pubsub.py): any process in the cluster can
+``publish(topic, message)``; a ``Subscriber`` long-polls the head with
+per-topic cursors and hands messages out in publish order. The head also
+feeds its own ``cluster_events`` topic (node add/death, actor
+death/restart), so observability tooling can watch membership the way
+the reference's dashboard subscribes to GCS channels.
+
+    sub = pubsub.Subscriber("jobs", "cluster_events")
+    pubsub.publish("jobs", {"status": "done"})
+    topic, msg = sub.get(timeout=5)
+
+Messages must be picklable; delivery is at-least-once from a bounded
+per-topic ring (default 1000): a subscriber that falls behind skips
+ahead and ``Subscriber.dropped`` counts what it missed.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core.worker import require_connected
+from ray_tpu.runtime.pubsub import PubsubBroker
+
+# local-mode broker (one process, no head): module singleton
+_local_broker: Optional[PubsubBroker] = None
+_local_lock = threading.Lock()
+
+
+def _broker_call(method: str, payload: dict):
+    worker = require_connected()
+    backend = worker.backend
+    head = getattr(backend, "head", None)
+    if head is not None:
+        return head.call_retrying(method, payload)
+    global _local_broker
+    with _local_lock:
+        if _local_broker is None:
+            _local_broker = PubsubBroker()
+        broker = _local_broker
+    if method == "pubsub_publish":
+        return broker.publish(payload["topic"], payload["message"])
+    if method == "pubsub_poll":
+        return broker.poll(payload["cursors"], payload.get("timeout_s", 2.0))
+    return broker.topics()
+
+
+def publish(topic: str, message: Any) -> int:
+    """Publish to a topic; returns the message's sequence number."""
+    return _broker_call("pubsub_publish",
+                        {"topic": topic, "message": message})
+
+
+def list_topics() -> dict:
+    """{"epoch": E, "topics": [(topic, latest_seq), ...]} for every
+    topic the broker has seen."""
+    return _broker_call("pubsub_topics", {})
+
+
+class Subscriber:
+    """Cursor-tracking subscriber. ``get()`` blocks for the next message
+    across all subscribed topics; ``get_all()`` drains without blocking.
+    Subscribing from "now" — messages published before the Subscriber
+    was created are not delivered (cursor starts at the topic head).
+
+    Cursors are epoch-checked: a head restart resets broker sequence
+    numbers, and stale cursors would otherwise silently stall (or skip)
+    delivery — on epoch change the subscriber rewinds to the new
+    broker's start, so restart-crossing delivery is at-least-nothing-
+    lost from the restart point onward."""
+
+    def __init__(self, *topics: str):
+        if not topics:
+            raise ValueError("Subscriber needs at least one topic")
+        self._cursors: Dict[str, int] = {}
+        self._queue: collections.deque = collections.deque()
+        self.dropped = 0
+        snap = list_topics()
+        self._epoch = snap.get("epoch")
+        latest = dict(snap.get("topics", []))
+        for t in topics:
+            self._cursors[t] = latest.get(t, 0)
+
+    def _pull(self, timeout_s: float) -> bool:
+        out = _broker_call("pubsub_poll", {"cursors": self._cursors,
+                                           "timeout_s": timeout_s})
+        if out.get("epoch") != self._epoch:
+            # head restarted: sequence space is fresh; rewind and rescan
+            self._epoch = out.get("epoch")
+            for t in self._cursors:
+                self._cursors[t] = 0
+            return False
+        got = False
+        for topic, r in out.get("topics", {}).items():
+            self._cursors[topic] = r["cursor"]
+            self.dropped += r.get("dropped", 0)
+            for m in r["messages"]:
+                self._queue.append((topic, m))
+                got = True
+        return got
+
+    def get(self, timeout: Optional[float] = None
+            ) -> Optional[Tuple[str, Any]]:
+        """Next (topic, message), or None on timeout."""
+        import time as _t
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        while not self._queue:
+            step = 2.0
+            if deadline is not None:
+                step = min(step, deadline - _t.monotonic())
+                if step <= 0:
+                    return None
+            self._pull(step)
+        return self._queue.popleft()
+
+    def get_all(self) -> list:
+        """Drain everything currently available without blocking."""
+        self._pull(0.0)
+        out = list(self._queue)
+        self._queue.clear()
+        return out

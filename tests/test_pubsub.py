@@ -1,0 +1,149 @@
+"""Topic pub/sub: broker semantics, cross-process delivery, cluster
+events (reference: src/ray/pubsub/ long-poll publisher/subscriber)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.pubsub import PubsubBroker
+from ray_tpu.util import pubsub
+
+
+# ------------------------------------------------------- broker (unit)
+
+def test_broker_roundtrip_and_cursors():
+    b = PubsubBroker(epoch="e1")
+    b.publish("t", {"n": 1})
+    b.publish("t", {"n": 2})
+    out = b.poll({"t": 0}, timeout_s=0)
+    assert out["epoch"] == "e1"
+    t = out["topics"]["t"]
+    assert [m["n"] for m in t["messages"]] == [1, 2]
+    cur = t["cursor"]
+    assert b.poll({"t": cur}, timeout_s=0)["topics"] == {}
+    b.publish("t", {"n": 3})
+    out = b.poll({"t": cur}, timeout_s=0)
+    assert [m["n"] for m in out["topics"]["t"]["messages"]] == [3]
+
+
+def test_broker_longpoll_wakeup():
+    b = PubsubBroker()
+    got = {}
+
+    def waiter():
+        got["out"] = b.poll({"t": 0}, timeout_s=5)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    b.publish("t", "hello")
+    th.join(timeout=5)
+    assert not th.is_alive()
+    # woke promptly, not at the poll deadline
+    assert time.monotonic() - t0 < 1.0
+    assert got["out"]["topics"]["t"]["messages"] == ["hello"]
+
+
+def test_broker_ring_overflow_reports_drops():
+    b = PubsubBroker(max_buffer=10)
+    for i in range(25):
+        b.publish("t", i)
+    out = b.poll({"t": 0}, timeout_s=0)["topics"]
+    assert out["t"]["messages"] == list(range(15, 25))
+    assert out["t"]["dropped"] == 15
+
+
+def test_broker_independent_topics():
+    b = PubsubBroker()
+    b.publish("a", 1)
+    b.publish("b", 2)
+    out = b.poll({"a": 0, "b": 0}, timeout_s=0)["topics"]
+    assert out["a"]["messages"] == [1] and out["b"]["messages"] == [2]
+    out = b.poll({"a": 1}, timeout_s=0)  # only a's cursor
+    assert out["topics"] == {}
+
+
+def test_subscriber_epoch_reset_resyncs():
+    """A broker swap with a new epoch (the head-restart shape) rewinds
+    subscriber cursors instead of silently stalling on stale ones."""
+    from ray_tpu.util import pubsub as ps
+    import ray_tpu
+    ray_tpu.init(local_mode=True)
+    try:
+        with ps._local_lock:
+            ps._local_broker = PubsubBroker(epoch="old")
+        sub = ps.Subscriber("swap")
+        ps.publish("swap", "before")
+        assert sub.get(timeout=5) == ("swap", "before")
+        # "head restart": fresh broker, fresh epoch, seqs restart at 0
+        with ps._local_lock:
+            ps._local_broker = PubsubBroker(epoch="new")
+        ps.publish("swap", "after")
+        # first pull notices the epoch change and rewinds; message lands
+        assert sub.get(timeout=5) == ("swap", "after")
+        assert sub._epoch == "new"
+    finally:
+        ray_tpu.shutdown()
+        with ps._local_lock:
+            ps._local_broker = None
+
+
+# --------------------------------------------------- cluster (processes)
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def _publisher_task(topic, n):
+    for i in range(n):
+        pubsub.publish(topic, {"i": i})
+    return n
+
+
+def test_pubsub_cross_process(rt):
+    sub = pubsub.Subscriber("crossproc")
+    assert ray_tpu.get(_publisher_task.remote("crossproc", 5)) == 5
+    got = []
+    while len(got) < 5:
+        item = sub.get(timeout=10)
+        assert item is not None, f"timed out after {len(got)} messages"
+        got.append(item)
+    assert [m["i"] for _, m in got] == list(range(5))
+
+
+def test_pubsub_two_subscribers_independent(rt):
+    s1 = pubsub.Subscriber("dup")
+    s2 = pubsub.Subscriber("dup")
+    pubsub.publish("dup", "x")
+    assert s1.get(timeout=10) == ("dup", "x")
+    assert s2.get(timeout=10) == ("dup", "x")
+
+
+def test_cluster_events_on_actor_death(rt):
+    @ray_tpu.remote(max_restarts=0)
+    class Victim:
+        def pid(self):
+            import os
+            return os.getpid()
+
+    sub = pubsub.Subscriber("cluster_events")
+    a = Victim.remote()
+    ray_tpu.get(a.pid.remote())
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 20
+    seen = []
+    while time.monotonic() < deadline:
+        item = sub.get(timeout=5)
+        if item is None:
+            continue
+        seen.append(item[1])
+        if any(e.get("event") == "actor_dead" for e in seen):
+            break
+    assert any(e.get("event") == "actor_dead" for e in seen), seen
