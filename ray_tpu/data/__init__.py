@@ -13,12 +13,12 @@ from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
 from ray_tpu.data._internal.shuffle import AggregateFn
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
-    from_items, from_numpy, range, read_csv, read_json, read_npy,
-    read_parquet, read_text)
+    from_items, from_numpy, from_pandas, range, read_csv, read_json,
+    read_npy, read_parquet, read_text)
 
 __all__ = [
     "ActorPoolStrategy", "AggregateFn", "GroupedData",
     "Block", "BlockAccessor", "Dataset", "MaterializedDataset",
-    "DataIterator", "from_items", "from_numpy", "range", "read_csv",
-    "read_json", "read_npy", "read_parquet", "read_text",
+    "DataIterator", "from_items", "from_numpy", "from_pandas", "range",
+    "read_csv", "read_json", "read_npy", "read_parquet", "read_text",
 ]

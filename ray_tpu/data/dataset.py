@@ -117,6 +117,30 @@ def _shuffle_transform(seed: int) -> _Transform:
     return _t
 
 
+def _copy_chunk(b: Block) -> Block:
+    """Per-block COPY of a slice — binding views would make every
+    downstream task cloudpickle the whole source block (numpy views
+    pickle only their elements, but deep-copy drops the base ref)."""
+    if isinstance(b, dict):
+        return {k: np.array(v) for k, v in b.items()}
+    if isinstance(b, np.ndarray):
+        return np.array(b)
+    return list(b)
+
+
+def _slice_into_reads(block: Block, num_blocks: int) -> List[Callable[[], Block]]:
+    """Near-even re-slice of one block into num_blocks copied read thunks
+    (shared by repartition and zip)."""
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    reads = []
+    for i in range(num_blocks):
+        s, e = i * n // num_blocks, (i + 1) * n // num_blocks
+        chunk = _copy_chunk(acc.slice(s, e))
+        reads.append(lambda _c=chunk: _c)
+    return reads
+
+
 class Dataset:
     def __init__(self, plan: _Plan):
         self._plan = plan
@@ -306,6 +330,42 @@ class Dataset:
                              max_in_flight=self._plan.max_in_flight,
                              ray_remote_args=dict(self._plan.ray_remote_args)))
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-wise combine with another dataset of the SAME length
+        (reference: dataset.py zip): dict blocks merge columns (right
+        side's colliding names get a ``_1`` suffix, as the reference
+        suffixes duplicates); other block kinds pair rows into tuples.
+        Both sides materialize — zip is an alignment barrier by nature."""
+        left = self._materialize_exact()
+        right = other._materialize_exact()
+        lb = [ray_tpu.get(r) for r in left._refs]    # noqa: SLF001
+        rb = [ray_tpu.get(r) for r in right._refs]   # noqa: SLF001
+        la = BlockAccessor.concat(lb) if lb else []
+        ra = BlockAccessor.concat(rb) if rb else []
+        lacc = BlockAccessor.for_block(la)
+        racc = BlockAccessor.for_block(ra)
+        if lacc.num_rows() != racc.num_rows():
+            raise ValueError(
+                f"zip needs equal lengths, got {lacc.num_rows()} vs "
+                f"{racc.num_rows()}")
+        if isinstance(la, dict) and isinstance(ra, dict):
+            merged = dict(la)
+            for k, v in ra.items():
+                name = k
+                i = 1
+                while name in merged:   # find a FREE suffix — writing to
+                    name = f"{k}_{i}"   # an occupied one would clobber a
+                    i += 1              # left-side column silently
+                merged[name] = v
+            combined: Block = merged
+        else:
+            lrows = lacc.to_rows()
+            rrows = racc.to_rows()
+            combined = [(a, b) for a, b in zip(lrows, rrows)]
+        # preserve the left side's block count so parallelism carries over
+        return Dataset(_Plan(
+            read_fns=_slice_into_reads(combined, max(1, len(lb)))))
+
     def split(self, n: int) -> List["Dataset"]:
         """Round-robin block partition into n shards (reference:
         dataset.py streaming_split's per-consumer sharding role), used to
@@ -327,26 +387,8 @@ class Dataset:
         mat = self.materialize()
         block = BlockAccessor.concat(
             [ray_tpu.get(r) for r in mat._refs])  # noqa: SLF001
-        acc = BlockAccessor.for_block(block)
-        n = acc.num_rows()
-
-        # Bind per-block COPIES, not a closure over the full concatenated
-        # block — otherwise every downstream task/shard would cloudpickle
-        # the entire dataset (numpy views pickle only their own elements,
-        # and deep-copying also drops the base-array reference).
-        def copy_chunk(b: Block) -> Block:
-            if isinstance(b, dict):
-                return {k: np.array(v) for k, v in b.items()}
-            if isinstance(b, np.ndarray):
-                return np.array(b)
-            return list(b)
-
-        reads = []
-        for i in range(num_blocks):
-            s, e = i * n // num_blocks, (i + 1) * n // num_blocks
-            chunk = copy_chunk(acc.slice(s, e))
-            reads.append(lambda _c=chunk: _c)
-        return Dataset(_Plan(read_fns=reads))
+        return Dataset(_Plan(
+            read_fns=_slice_into_reads(block, num_blocks)))
 
     # ---------------------------------------------------------- execution
     def _execute(self) -> Iterator:
@@ -441,6 +483,130 @@ class Dataset:
     def materialize(self) -> "MaterializedDataset":
         refs = [block_ref for block_ref, _ in self._execute()]
         return MaterializedDataset(refs, limit_rows=self._plan.limit_rows)
+
+    # --------------------------------------------------------------- output
+
+    def to_pandas(self):
+        """Whole dataset as one pandas DataFrame (reference:
+        dataset.py to_pandas). Assembled from columnar batches — no
+        per-row dict churn for table datasets."""
+        import pandas as pd
+        parts = list(self.iter_batches(batch_size=65536,
+                                       batch_format="dict"))
+        if not parts:
+            return pd.DataFrame()
+        first = parts[0]
+        if isinstance(first, dict) and first and \
+                all(isinstance(v, np.ndarray) for v in first.values()):
+            cols = {k: np.concatenate([p[k] for p in parts])
+                    for k in first}
+            return pd.DataFrame(cols)
+        rows = [r for p in parts
+                for r in BlockAccessor.for_block(p).to_rows()]
+        if rows and isinstance(rows[0], dict):
+            return pd.DataFrame(rows)
+        return pd.DataFrame({"value": rows})
+
+    def _write_blocks(self, path: str, suffix: str,
+                      write_one: Callable[[Block, str], None]) -> List[str]:
+        """Write one file per block via remote tasks (reference:
+        data write tasks fan out per block). Returns written paths."""
+        import os
+        os.makedirs(path, exist_ok=True)
+        src = self
+        if self._plan.limit_rows is not None:
+            # _execute() only stops SUBMISSION at the limit: the boundary
+            # block keeps its overshoot rows; materialize-exact truncates
+            src = self._materialize_exact()
+
+        @ray_tpu.remote
+        def _write(block: Block, out_path: str) -> str:
+            write_one(block, out_path)
+            return out_path
+
+        refs = []
+        for i, (block_ref, meta) in enumerate(src._execute()):
+            out_path = os.path.join(path, f"part-{i:05d}{suffix}")
+            refs.append(_write.remote(block_ref, out_path))
+        return ray_tpu.get(refs)
+
+    def write_json(self, path: str) -> List[str]:
+        """One JSON-lines file per block under ``path`` (reference:
+        dataset.py write_json)."""
+        def write_one(block: Block, out_path: str) -> None:
+            import json
+            acc = BlockAccessor.for_block(block)
+
+            def clean(r):
+                if isinstance(r, dict):
+                    return {k: v.tolist() if hasattr(v, "tolist") else v
+                            for k, v in r.items()}
+                return r.tolist() if hasattr(r, "tolist") else r
+            with open(out_path, "w") as f:
+                for r in acc.to_rows():
+                    f.write(json.dumps(clean(r)) + "\n")
+        return self._write_blocks(path, ".jsonl", write_one)
+
+    def write_csv(self, path: str) -> List[str]:
+        """One CSV file per block under ``path`` (reference:
+        dataset.py write_csv). Requires dict (columnar) blocks."""
+        def write_one(block: Block, out_path: str) -> None:
+            import csv
+            acc = BlockAccessor.for_block(block)
+            rows = acc.to_rows()
+            if rows and not isinstance(rows[0], dict):
+                rows = [{"value": r} for r in rows]
+            cols = list(rows[0].keys()) if rows else []
+            with open(out_path, "w", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=cols)
+                w.writeheader()
+                for r in rows:
+                    w.writerow({k: (v.item() if hasattr(v, "item") else v)
+                                for k, v in r.items()})
+        return self._write_blocks(path, ".csv", write_one)
+
+    def write_parquet(self, path: str) -> List[str]:
+        """One parquet file per block under ``path`` (reference:
+        dataset.py write_parquet). Gated on pyarrow."""
+        try:
+            import pyarrow  # noqa: F401
+        except ImportError as e:
+            raise ImportError("write_parquet requires pyarrow; use "
+                              "write_json/write_csv") from e
+
+        def write_one(block: Block, out_path: str) -> None:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+            acc = BlockAccessor.for_block(block)
+            table = acc.to_table()
+            pq.write_table(
+                pa.table({k: np.asarray(v) for k, v in table.items()}),
+                out_path)
+        return self._write_blocks(path, ".parquet", write_one)
+
+    def write_npy(self, path: str) -> List[str]:
+        """One .npy file per block under ``path`` — TENSOR datasets only
+        (a dict/row block would pickle into an object array that
+        read_npy's allow_pickle=False then refuses to load; use
+        write_parquet/write_json for tables)."""
+        def write_one(block: Block, out_path: str) -> None:
+            if not isinstance(block, np.ndarray):
+                arr = np.asarray(block)
+                if arr.dtype == object:
+                    raise TypeError(
+                        "write_npy needs tensor blocks; this dataset has "
+                        f"{type(block).__name__} blocks — use "
+                        "write_parquet or write_json")
+            else:
+                arr = block
+            np.save(out_path, arr)
+        return self._write_blocks(path, ".npy", write_one)
+
+    def iterator(self):
+        """A DataIterator over this dataset (reference: dataset.py
+        iterator() -> DataIterator)."""
+        from ray_tpu.data.iterator import DataIterator
+        return DataIterator(self)
 
     def num_blocks(self) -> int:
         return len(self._plan.read_fns)

@@ -63,6 +63,31 @@ class DataIterator:
             padded[mask_column] = mask
             yield padded
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False) -> Iterator[Any]:
+        """Dict-of-torch-tensor batches (reference: data/iterator.py
+        iter_torch_batches). Gated on torch; numeric columns convert
+        zero-copy via torch.from_numpy, others stay as lists."""
+        import torch
+
+        def to_tensor(v):
+            if isinstance(v, np.ndarray) and v.dtype.kind in "biuf":
+                arr = np.ascontiguousarray(v)
+                if not arr.flags.writeable:
+                    # torch.from_numpy warns on (and can't track) read-
+                    # only arrays, e.g. zero-copy views out of shm
+                    arr = arr.copy()
+                t = torch.from_numpy(arr)
+                if dtypes is not None:
+                    t = t.to(dtypes)
+                return t.to(device) if device != "cpu" else t
+            return v
+        for batch in self._ds.iter_batches(batch_size=batch_size,
+                                           batch_format="dict",
+                                           drop_last=drop_last):
+            yield {k: to_tensor(v) for k, v in batch.items()}
+
     def materialize(self) -> Dataset:
         return self._ds.materialize()
 

@@ -53,6 +53,19 @@ def from_items(items: Sequence[Any], *,
     return Dataset(_Plan(read_fns=reads))
 
 
+def from_pandas(df, *, num_blocks: Optional[int] = None) -> Dataset:
+    """Dataset from a pandas DataFrame (reference: data/read_api.py
+    from_pandas): columns become the dict-block table."""
+    cols = [str(c) for c in df.columns]
+    if len(set(cols)) != len(cols):
+        # pandas allows duplicate labels; df[c] would then return a 2-D
+        # frame and the dict would silently drop all but one column
+        raise ValueError(f"from_pandas needs unique column names, got "
+                         f"{cols}")
+    table = {str(c): df[c].to_numpy() for c in df.columns}
+    return from_numpy(table, num_blocks=num_blocks)
+
+
 def from_numpy(arr: Union[np.ndarray, Dict[str, np.ndarray]], *,
                num_blocks: Optional[int] = None) -> Dataset:
     if isinstance(arr, dict):

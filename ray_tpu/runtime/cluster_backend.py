@@ -768,7 +768,12 @@ class ClusterBackend:
         with self._lock:
             # freed objects must not be reconstructable (and dead
             # TaskSpecs with inline args are driver-memory ballast)
-            self._lineage.pop(object_id.binary(), None)
+            dropped = self._lineage.pop(object_id.binary(), None)
+        # the popped spec dies OUTSIDE the lock: a spec holding the last
+        # handle to inline-arg ObjectRefs fires their __del__ -> nested
+        # free_object, which must re-acquire self._lock (self-deadlock on
+        # this non-reentrant lock if the drop happened inside)
+        del dropped
         self.object_plane.free_object(object_id)
 
     def try_resolve(self, ref: ObjectRef) -> bool:

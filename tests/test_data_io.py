@@ -1,0 +1,130 @@
+"""Data: zip, pandas interop, write APIs, torch iterator (reference:
+data/dataset.py zip/write_*/to_pandas, data/iterator.py
+iter_torch_batches)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_zip_dict_blocks(rt):
+    a = rd.from_numpy({"x": np.arange(10)}, num_blocks=3)
+    b = rd.from_numpy({"y": np.arange(10) * 2}, num_blocks=2)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert len(rows) == 10
+    assert all(r["y"] == 2 * r["x"] for r in rows)
+    assert z.num_blocks() == 3  # left side's block count carries over
+
+
+def test_zip_column_collision_suffixes(rt):
+    a = rd.from_numpy({"x": np.arange(4)})
+    b = rd.from_numpy({"x": np.arange(4) + 100})
+    rows = a.zip(b).take_all()
+    assert rows[0].keys() == {"x", "x_1"}
+    assert rows[2]["x"] == 2 and rows[2]["x_1"] == 102
+
+
+def test_zip_row_blocks_pairs(rt):
+    a = rd.from_items(["a", "b", "c"])
+    b = rd.from_items([1, 2, 3])
+    assert a.zip(b).take_all() == [("a", 1), ("b", 2), ("c", 3)]
+
+
+def test_zip_length_mismatch_raises(rt):
+    with pytest.raises(ValueError):
+        rd.from_items([1, 2]).zip(rd.from_items([1, 2, 3]))
+
+
+def test_zip_applies_pending_transforms(rt):
+    a = rd.range(6).map(lambda r: {"x": r["id"] * 10})
+    b = rd.range(6).filter(lambda r: True)
+    rows = a.zip(b).take_all()
+    assert rows[3]["x"] == 30 and rows[3]["id"] == 3
+
+
+def test_pandas_roundtrip(rt):
+    import pandas as pd
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]})
+    ds = rd.from_pandas(df, num_blocks=2)
+    assert ds.count() == 3
+    back = ds.to_pandas()
+    assert list(back.columns) == ["a", "b"]
+    assert back["a"].tolist() == [1, 2, 3]
+
+
+def test_write_json_roundtrip(rt, tmp_path):
+    ds = rd.from_numpy({"v": np.arange(7)}, num_blocks=2)
+    paths = ds.write_json(str(tmp_path / "out"))
+    assert len(paths) == 2 and all(p.endswith(".jsonl") for p in paths)
+    back = rd.read_json([str(tmp_path / "out")])
+    vals = sorted(r["v"] for r in back.take_all())
+    assert vals == list(np.arange(7))
+
+
+def test_write_csv_roundtrip(rt, tmp_path):
+    ds = rd.from_numpy({"a": np.arange(5), "b": np.arange(5) * 1.5})
+    paths = ds.write_csv(str(tmp_path / "csvs"))
+    back = rd.read_csv([str(tmp_path / "csvs")])
+    rows = sorted(back.take_all(), key=lambda r: r["a"])
+    assert len(rows) == 5
+    assert float(rows[4]["b"]) == 6.0
+
+
+def test_write_parquet_roundtrip(rt, tmp_path):
+    pytest.importorskip("pyarrow")
+    ds = rd.from_numpy({"k": np.arange(6)}, num_blocks=2)
+    paths = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(paths) == 2
+    back = rd.read_parquet([str(tmp_path / "pq")])
+    assert sorted(r["k"] for r in back.take_all()) == list(np.arange(6))
+
+
+def test_iter_torch_batches(rt):
+    torch = pytest.importorskip("torch")
+    ds = rd.from_numpy({"x": np.arange(10, dtype=np.float32)})
+    batches = list(ds.iterator().iter_torch_batches(batch_size=4))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].dtype == torch.float32
+    total = torch.cat([b["x"] for b in batches]).sum().item()
+    assert total == float(np.arange(10).sum())
+
+
+def test_write_respects_limit(rt, tmp_path):
+    # limit() truncates the boundary block in write paths too
+    rd.range(100, num_blocks=1).limit(5).write_json(str(tmp_path / "lim"))
+    back = rd.read_json([str(tmp_path / "lim")])
+    assert back.count() == 5
+
+
+def test_write_npy_tensor_roundtrip(rt, tmp_path):
+    arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+    rd.from_numpy(arr, num_blocks=2).write_npy(str(tmp_path / "npy"))
+    back = rd.read_npy([str(tmp_path / "npy")])
+    got = np.concatenate([b for b in back.iter_batches(
+        batch_size=6, batch_format="numpy")])
+    assert got.shape == (6, 2)
+
+
+def test_write_npy_rejects_tables(rt, tmp_path):
+    import pytest as _pt
+    with _pt.raises(Exception):  # TypeError surfaces through the task
+        rd.from_items([{"a": 1}, {"a": 2}]).write_npy(str(tmp_path / "bad"))
+
+
+def test_zip_double_collision_keeps_all(rt):
+    a = rd.from_numpy({"x": np.arange(3), "x_1": np.arange(3) + 10})
+    b = rd.from_numpy({"x": np.arange(3) + 100})
+    rows = a.zip(b).take_all()
+    assert rows[0].keys() == {"x", "x_1", "x_2"}
+    assert rows[1]["x_1"] == 11 and rows[1]["x_2"] == 101
