@@ -10,6 +10,8 @@ store.
 
 from ray_tpu.rllib.algorithm import PPO, PPOConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.impala import (IMPALA, IMPALAConfig, IMPALALearner,
+                                  vtrace)
 from ray_tpu.rllib.replay import ReplayBuffer
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVectorEnv, VectorEnv
 from ray_tpu.rllib.env_runner import EnvRunner
@@ -18,6 +20,7 @@ from ray_tpu.rllib.module import forward, init_module, sample_actions
 
 __all__ = [
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
+    "IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace",
     "PPO", "PPOConfig", "PPOLearner", "EnvRunner", "VectorEnv",
     "CartPoleVectorEnv", "ENV_REGISTRY", "compute_gae", "init_module",
     "forward", "sample_actions",

@@ -11,15 +11,15 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.env import ENV_REGISTRY
-from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner
 from ray_tpu.rllib.module import init_module
+from ray_tpu.rllib.trainer_base import TrainerBase
 
 
 @dataclasses.dataclass
@@ -43,7 +43,7 @@ class PPOConfig:
         return PPO(self, mesh=mesh)
 
 
-class PPO:
+class PPO(TrainerBase):
     def __init__(self, config: PPOConfig, mesh=None):
         import jax
         self.config = config
@@ -58,18 +58,9 @@ class PPO:
             vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
             num_epochs=config.num_epochs, minibatches=config.minibatches,
             mesh=mesh)
-        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
-        self.runners: List[Any] = [
-            runner_cls.remote(config.env, config.num_envs_per_runner,
-                              config.rollout_length, seed=config.seed + i)
-            for i in range(config.num_env_runners)]
-        self.iteration = 0
-        self._return_window: List[float] = []
-
-    def _broadcast_weights(self) -> None:
-        ref = ray_tpu.put(self.params)
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners],
-                    timeout=120)
+        self._make_runners(config.env, config.num_env_runners,
+                           config.num_envs_per_runner,
+                           config.rollout_length, config.seed)
 
     def train(self) -> Dict[str, Any]:
         """One training iteration (reference: Algorithm.train)."""
@@ -89,29 +80,8 @@ class PPO:
             [b["episode_returns"] for b in batches])
         self._key, sub = jax.random.split(self._key)
         self.params, metrics = self.learner.update(self.params, batch, sub)
-        self.iteration += 1
-        if len(returns):
-            self._return_window.extend(returns.tolist())
-            self._return_window = self._return_window[-100:]
-        return {
-            "training_iteration": self.iteration,
-            "episode_return_mean": float(np.mean(self._return_window))
-            if self._return_window else float("nan"),
-            "episodes_this_iter": int(len(returns)),
-            "env_steps_this_iter": int(batch["rewards"].size),
-            "learner": metrics,
-            "time_this_iter_s": round(time.monotonic() - t0, 3),
-        }
-
-    def stop(self) -> None:
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass
-
-    def get_weights(self):
-        return self.params
-
-    def set_weights(self, params) -> None:
-        self.params = params
+        self._track_returns(returns)
+        return self._base_result(
+            episodes=int(len(returns)), t0=t0,
+            env_steps_this_iter=int(batch["rewards"].size),
+            learner=metrics)

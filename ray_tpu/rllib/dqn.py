@@ -24,7 +24,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.env import ENV_REGISTRY
-from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.trainer_base import TrainerBase
 from ray_tpu.rllib.module import forward, init_module
 from ray_tpu.rllib.replay import ReplayBuffer
 
@@ -109,7 +109,7 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN:
+class DQN(TrainerBase):
     def __init__(self, config: DQNConfig):
         import jax
         self.config = config
@@ -121,15 +121,11 @@ class DQN:
         self.learner = DQNLearner(lr=config.lr, gamma=config.gamma)
         self.buffer = ReplayBuffer(config.buffer_capacity,
                                    spec.observation_dim, seed=config.seed)
-        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
-        self.runners: List[Any] = [
-            runner_cls.remote(config.env, config.num_envs_per_runner,
-                              config.rollout_length, seed=config.seed + i,
-                              exploration="epsilon_greedy")
-            for i in range(config.num_env_runners)]
-        self.iteration = 0
+        self._make_runners(config.env, config.num_env_runners,
+                           config.num_envs_per_runner,
+                           config.rollout_length, config.seed,
+                           exploration="epsilon_greedy")
         self.num_updates = 0
-        self._return_window: List[float] = []
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -141,9 +137,7 @@ class DQN:
         cfg = self.config
         t0 = time.monotonic()
         eps = self._epsilon()
-        ref = ray_tpu.put(self.params)
-        ray_tpu.get([r.set_weights.remote(ref, epsilon=eps)
-                     for r in self.runners], timeout=120)
+        self._broadcast_weights(epsilon=eps)
         batches = ray_tpu.get(
             [r.sample.remote() for r in self.runners], timeout=600)
         returns: List[float] = []
@@ -169,32 +163,9 @@ class DQN:
                 self.num_updates += 1
                 if self.num_updates % cfg.target_sync_every == 0:
                     self.target_params = self.params
-        self.iteration += 1
-        if returns:
-            self._return_window.extend(returns)
-            self._return_window = self._return_window[-100:]
-        return {
-            "training_iteration": self.iteration,
-            "episode_return_mean": float(np.mean(self._return_window))
-            if self._return_window else float("nan"),
-            "episodes_this_iter": len(returns),
-            "buffer_size": len(self.buffer),
-            "epsilon": round(eps, 4),
-            "num_updates": self.num_updates,
-            "learner": metrics,
-            "time_this_iter_s": round(time.monotonic() - t0, 3),
-        }
-
-    def stop(self) -> None:
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass
-
-    def get_weights(self):
-        return self.params
-
-    def set_weights(self, params) -> None:
-        self.params = params
+        self._track_returns(returns)
+        return self._base_result(
+            episodes=len(returns), t0=t0,
+            buffer_size=len(self.buffer), epsilon=round(eps, 4),
+            num_updates=self.num_updates, learner=metrics)
         self.target_params = params

@@ -1,0 +1,227 @@
+"""IMPALA — asynchronous sampling with V-trace off-policy correction.
+
+Role-equivalent to the reference's IMPALA (reference:
+rllib/algorithms/impala/impala.py and the aggregator/learner-queue
+machinery under rllib/algorithms/impala/): env runners sample
+CONTINUOUSLY with whatever weights they last received — no per-iteration
+barrier — and the learner consumes rollout batches as they land,
+correcting for policy lag with V-trace (Espeholt et al. 2018) clipped
+importance weights. This is the algorithm that proves the
+EnvRunner/Learner seams under ASYNC training: PPO synchronizes
+sample->update->broadcast per iteration, DQN replays, IMPALA overlaps
+all three.
+
+TPU-first: the whole V-trace + policy-gradient + value + entropy update
+is ONE jitted program (reverse lax.scan for the v_s targets); the async
+part — wait-any over in-flight sample refs, per-runner weight pushes —
+is plain object-store orchestration, so the device never waits on a
+rendezvous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY
+from ray_tpu.rllib.module import forward, init_module
+from ray_tpu.rllib.trainer_base import TrainerBase
+
+
+def vtrace(behavior_logp, target_logp, values, rewards, dones, last_value,
+           *, gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0):
+    """V-trace targets and policy-gradient advantages.
+
+    All inputs [T, B] (last_value [B]). Returns (vs [T, B], pg_adv [T, B]):
+    vs are the off-policy-corrected value targets, pg_adv the clipped-rho
+    advantages for the policy gradient.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rho = jnp.minimum(rho_clip, rhos)
+    cs = jnp.minimum(c_clip, rhos)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    v_next = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    # bootstrap past episode ends: the value after a terminal step is 0
+    deltas = clipped_rho * (rewards + gamma * v_next * nonterminal - values)
+
+    def step(acc, inp):
+        delta, c, nt = inp
+        acc = delta + gamma * c * nt * acc
+        return acc, acc
+
+    _, corrections = jax.lax.scan(
+        step, jnp.zeros_like(last_value),
+        (deltas, cs, nonterminal), reverse=True)
+    vs = values + corrections
+    vs_next = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = clipped_rho * (rewards + gamma * vs_next * nonterminal - values)
+    return vs, pg_adv
+
+
+class IMPALALearner:
+    """One jitted V-trace actor-critic update (reference:
+    rllib/algorithms/impala/impala_learner.py role)."""
+
+    def __init__(self, *, lr: float = 6e-4, gamma: float = 0.99,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 rho_clip: float = 1.0, c_clip: float = 1.0,
+                 max_grad_norm: float = 40.0, mesh=None):
+        import optax
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm), optax.adam(lr))
+        self.mesh = mesh
+        self.opt_state = None
+        import jax
+        self._update = jax.jit(functools.partial(
+            self._update_impl, gamma=gamma, vf=vf_coeff, ent=entropy_coeff,
+            rho_clip=rho_clip, c_clip=c_clip))
+
+    def _update_impl(self, params, opt_state, batch, *, gamma, vf, ent,
+                     rho_clip, c_clip):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        def loss_fn(p):
+            T, B = batch["rewards"].shape
+            obs_flat = batch["obs"].reshape(T * B, -1)
+            logits, values = forward(p, obs_flat)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = logp_all[jnp.arange(T * B),
+                            batch["actions"].reshape(T * B)]
+            logp = logp.reshape(T, B)
+            values = values.reshape(T, B)
+            # bootstrap value recomputed from last_obs under CURRENT
+            # params: the runner's shipped last_value came from weights
+            # up to several updates old, and mixing that stale critic
+            # into the boundary of the v_s recursion biases the targets
+            # by exactly the policy lag V-trace is meant to correct
+            _, last_value = forward(p, batch["last_obs"])
+            # V-trace targets use the CURRENT policy's values but must
+            # not backprop through the target computation
+            vs, pg_adv = vtrace(
+                batch["logp"], jax.lax.stop_gradient(logp),
+                jax.lax.stop_gradient(values), batch["rewards"],
+                batch["dones"], jax.lax.stop_gradient(last_value),
+                gamma=gamma, rho_clip=rho_clip, c_clip=c_clip)
+            vs = jax.lax.stop_gradient(vs)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            pg_loss = -(pg_adv * logp).mean()
+            v_loss = 0.5 * ((values - vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            return pg_loss + vf * v_loss - ent * entropy, (v_loss, entropy)
+
+        (loss, (v_loss, entropy)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "v_loss": v_loss,
+                                   "entropy": entropy}
+
+    def update(self, params, batch: Dict[str, np.ndarray]):
+        import jax
+        import jax.numpy as jnp
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init(params)
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k in ("obs", "actions", "logp", "rewards", "dones",
+                       "last_obs")}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # dp-shard the env axis (dim 1 of [T, B, ...]; last_obs is
+            # [B, ...]) — same layout as PPOLearner.update
+            for k in ("obs", "actions", "logp", "rewards", "dones"):
+                jb[k] = jax.device_put(
+                    jb[k], NamedSharding(self.mesh,
+                                         P(None, ("dp", "fsdp"))))
+            jb["last_obs"] = jax.device_put(
+                jb["last_obs"], NamedSharding(self.mesh,
+                                              P(("dp", "fsdp"))))
+        params, self.opt_state, metrics = self._update(
+            params, self.opt_state, jb)
+        return params, {k: float(v) for k, v in metrics.items()}
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 16
+    rollout_length: int = 32
+    batches_per_iteration: int = 8
+    lr: float = 6e-4
+    gamma: float = 0.99
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self, mesh=None) -> "IMPALA":
+        return IMPALA(self, mesh=mesh)
+
+
+class IMPALA(TrainerBase):
+    """Async trainer: every runner always has a sample() in flight; the
+    learner updates on whichever batch lands first and pushes fresh
+    weights to THAT runner only — no global barrier, runners never idle
+    (reference: impala.py training_step's async sample+learn loop)."""
+
+    def __init__(self, config: IMPALAConfig, mesh=None):
+        import jax
+        self.config = config
+        spec = ENV_REGISTRY[config.env](1)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._key, sub = jax.random.split(self._key)
+        self.params = init_module(sub, spec.observation_dim,
+                                  spec.num_actions, config.hidden)
+        self.learner = IMPALALearner(
+            lr=config.lr, gamma=config.gamma, vf_coeff=config.vf_coeff,
+            entropy_coeff=config.entropy_coeff, rho_clip=config.rho_clip,
+            c_clip=config.c_clip, mesh=mesh)
+        self._make_runners(config.env, config.num_env_runners,
+                           config.num_envs_per_runner,
+                           config.rollout_length, config.seed)
+        self._broadcast_weights()
+        # one sample PERMANENTLY in flight per runner — the async core
+        self._inflight: Dict[Any, Any] = {
+            r.sample.remote(): r for r in self.runners}
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration = consume batches_per_iteration async batches."""
+        t0 = time.monotonic()
+        env_steps = 0
+        episodes = 0
+        metrics: Dict[str, float] = {}
+        for _ in range(self.config.batches_per_iteration):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=600)
+            if not ready:
+                from ray_tpu.exceptions import GetTimeoutError
+                raise GetTimeoutError(
+                    f"no env-runner produced a batch within 600s "
+                    f"({len(self._inflight)} in flight — runners dead?)")
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            self.params, metrics = self.learner.update(self.params, batch)
+            env_steps += int(batch["rewards"].size)
+            returns = batch["episode_returns"]
+            episodes += len(returns)
+            self._track_returns(returns)
+            # fresh weights to this runner only, then it resamples —
+            # other runners keep producing with their (stale) weights
+            runner.set_weights.remote(ray_tpu.put(self.params))
+            self._inflight[runner.sample.remote()] = runner
+        return self._base_result(
+            episodes=episodes, t0=t0,
+            env_steps_this_iter=env_steps, learner=metrics)

@@ -1,0 +1,79 @@
+"""Shared trainer scaffold for the algorithm classes.
+
+Role-equivalent to the reference's Algorithm base responsibilities
+(reference: rllib/algorithms/algorithm.py:199 — EnvRunnerGroup setup,
+weight sync, metric windows, teardown) without the Trainable plumbing:
+PPO/DQN/IMPALA each own only their training_step logic."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunner
+
+RETURN_WINDOW = 100
+
+
+class TrainerBase:
+    """Runner-pool construction, weight broadcast, episode-return window,
+    and teardown — the parts every algorithm previously duplicated."""
+
+    runners: List[Any]
+    params: Any
+
+    def _make_runners(self, env: str, num_runners: int, num_envs: int,
+                      rollout_len: int, seed: int,
+                      exploration: str = "categorical") -> None:
+        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+        self.runners = [
+            runner_cls.remote(env, num_envs, rollout_len, seed=seed + i,
+                              exploration=exploration)
+            for i in range(num_runners)]
+        self.iteration = 0
+        self._return_window: List[float] = []
+
+    def _broadcast_weights(self, epsilon: Optional[float] = None) -> None:
+        """One store write, every runner reads the same copy."""
+        ref = ray_tpu.put(self.params)
+        kw = {} if epsilon is None else {"epsilon": epsilon}
+        ray_tpu.get([r.set_weights.remote(ref, **kw)
+                     for r in self.runners], timeout=120)
+
+    def _track_returns(self, returns) -> None:
+        if len(returns):
+            self._return_window.extend(
+                returns.tolist() if hasattr(returns, "tolist")
+                else list(returns))
+            self._return_window = self._return_window[-RETURN_WINDOW:]
+
+    def _return_mean(self) -> float:
+        return float(np.mean(self._return_window)) \
+            if self._return_window else float("nan")
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def _base_result(self, *, episodes: int, t0: float,
+                     **extra) -> Dict[str, Any]:
+        import time
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": self._return_mean(),
+            "episodes_this_iter": episodes,
+            "time_this_iter_s": round(time.monotonic() - t0, 3),
+            **extra,
+        }
