@@ -1,0 +1,106 @@
+"""util extras: multiprocessing.Pool shim + joblib backend (reference:
+python/ray/util/multiprocessing/pool.py, python/ray/util/joblib/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_pool_map_and_starmap(rt):
+    with Pool(processes=4) as p:
+        assert p.map(_sq, range(20)) == [x * x for x in range(20)]
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_apply_and_async(rt):
+    with Pool(processes=2) as p:
+        assert p.apply(_add, (2, 3)) == 5
+        r = p.apply_async(_sq, (9,))
+        assert r.get(timeout=30) == 81
+        assert r.ready() and r.successful()
+
+
+def test_pool_imap_ordered_and_unordered(rt):
+    with Pool(processes=2) as p:
+        assert list(p.imap(_sq, range(10), chunksize=3)) == \
+            [x * x for x in range(10)]
+        assert sorted(p.imap_unordered(_sq, range(10), chunksize=3)) == \
+            sorted(x * x for x in range(10))
+
+
+def test_pool_initializer_runs(rt):
+    import os
+
+    def init_marker(v):
+        os.environ["_POOL_MARK"] = str(v)
+
+    def read_marker(_):
+        import os as _os
+        return _os.environ.get("_POOL_MARK")
+
+    with Pool(processes=2, initializer=init_marker, initargs=(7,)) as p:
+        out = p.map(read_marker, range(4), chunksize=1)
+    assert all(v == "7" for v in out), out
+
+
+def test_pool_closed_rejects(rt):
+    p = Pool(processes=1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+    p.join()
+
+
+def test_joblib_backend(rt):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+    from joblib import Parallel, delayed
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = Parallel()(delayed(_sq)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
+
+
+def test_imap_streams_infinite_input(rt):
+    import itertools
+    with Pool(processes=2) as p:
+        gen = p.imap(_sq, itertools.count())
+        first = [next(gen) for _ in range(5)]
+    assert first == [0, 1, 4, 9, 16]
+
+
+def test_join_waits_for_outstanding(rt):
+    import os
+    import tempfile
+    import time as _time
+    mark = tempfile.mktemp()
+
+    def slow_write(path):
+        _time.sleep(1.0)
+        with open(path, "w") as f:
+            f.write("done")
+        return path
+
+    p = Pool(processes=1)
+    p.map_async(slow_write, [mark])
+    p.close()
+    p.join()
+    # the stdlib barrier: after join() the side effect must exist
+    assert os.path.exists(mark)
+    os.unlink(mark)
