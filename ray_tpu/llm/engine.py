@@ -8,11 +8,13 @@ admission, scheduling), rebuilt TPU-first:
     (max_batch slots); empty slots point at the scratch page, so joining
     and leaving sequences never changes the program (XLA recompiles on
     shape change — the cardinal sin of TPU serving loops);
-  - prompts prefill one-at-a-time through a length-bucketed jit (prompt
-    padded to the next power-of-two bucket: a handful of compiles total),
-    then their K/V is written into pages and the sequence joins the
-    decode batch — i.e. decode of running sequences is never blocked for
-    longer than one prefill;
+  - prompts prefill in same-length-bucket GROUPS through a bucketed jit
+    (prompt padded to the next power-of-two length bucket, group padded
+    to a power-of-two size: compile count stays |len buckets| x |size
+    buckets|), then each sequence's K/V is written into its pages and it
+    joins the decode batch — decode of running sequences is never
+    blocked for longer than one (batched) prefill, and a deep admission
+    queue amortizes the dispatch instead of serializing TTFT;
   - pages allocate with one page of decode headroom and grow by one page
     whenever the sequence fills its last page.
 """
@@ -32,7 +34,7 @@ import numpy as np
 
 from ray_tpu.llm.cache import (SCRATCH_PAGE, PageAllocator, SequenceState,
                                make_kv_cache)
-from ray_tpu.llm.model import decode_loop, prefill
+from ray_tpu.llm.model import decode_loop, prefill, prefill_many
 from ray_tpu.models.llama import LlamaConfig, init_params
 from ray_tpu.ops.paged_attention import write_prefill_kv
 
@@ -74,7 +76,7 @@ class InferenceEngine:
                  page_size: int = 16, total_pages: int = 256,
                  max_batch: int = 8, max_seq_len: int = 1024,
                  eos_token: Optional[int] = None, seed: int = 0,
-                 decode_chunk: int = 8):
+                 decode_chunk: int = 8, prefill_batch: int = 4):
         self.cfg = cfg
         self.params = params if params is not None \
             else init_params(cfg, jax.random.PRNGKey(seed))
@@ -87,6 +89,9 @@ class InferenceEngine:
         # tunneled chip), so K steps ride one trip (vLLM multi-step
         # scheduling); finished sequences overshoot at most K-1 tokens
         self.decode_chunk = max(1, decode_chunk)
+        # prompts admitted per prefill dispatch (same length bucket):
+        # amortizes dispatch + compute across a deep admission queue
+        self.prefill_batch = max(1, prefill_batch)
         self.k_cache, self.v_cache = make_kv_cache(cfg, total_pages,
                                                    page_size)
         self.allocator = PageAllocator(total_pages)
@@ -100,8 +105,9 @@ class InferenceEngine:
                                    SCRATCH_PAGE, np.int32)
         self._positions = np.zeros(max_batch, np.int32)
         self._tokens = np.zeros(max_batch, np.int32)
-        self.stats = {"prefill_tokens": 0, "decode_steps": 0,
-                      "decode_tokens": 0, "decode_dispatches": 0}
+        self.stats = {"prefill_tokens": 0, "prefill_dispatches": 0,
+                      "decode_steps": 0, "decode_tokens": 0,
+                      "decode_dispatches": 0}
         self._finished_at_prefill: Dict[str, List[int]] = {}
         # tokens generated since the last drain_progress() call, per live
         # request — the incremental surface token streaming rides on
@@ -144,9 +150,9 @@ class InferenceEngine:
     # ---------------------------------------------------------------- step
 
     def step(self) -> Dict[str, List[int]]:
-        """Admit at most one waiting request (prefill), then one decode
-        step for the whole running batch. Returns {request_id: generated}
-        for sequences that FINISHED this step."""
+        """Admit a group of waiting requests (one batched prefill), then
+        one decode chunk for the whole running batch. Returns
+        {request_id: generated} for sequences that FINISHED this step."""
         self._admit()
         finished = self._decode()
         if self._finished_at_prefill:
@@ -161,30 +167,78 @@ class InferenceEngine:
         return None
 
     def _admit(self) -> None:
+        """Admit a GROUP of same-length-bucket waiting requests in one
+        batched prefill dispatch (up to prefill_batch, bounded by free
+        slots and cache pages). Under a deep queue this amortizes the
+        per-dispatch cost that made TTFT grow linearly with queue depth;
+        a lone request still rides the single-prompt program."""
+        group: List = []   # (seq, slot, pages)
         with self._lock:
             if not self.waiting:
                 return
-            slot = self._free_slot()
-            if slot is None:
-                return
-            seq = self.waiting[0]
-            n_pages = seq.pages_needed(self.page_size, headroom=1)
-            pages = self.allocator.alloc(n_pages)
-            if pages is None:
-                return  # no memory: wait for a finish to free pages
-            self.waiting.pop(0)
+            bucket = _bucket(len(self.waiting[0].prompt))
+            taken: List[int] = []
+            while self.waiting and len(group) < self.prefill_batch:
+                seq = self.waiting[0]
+                if _bucket(len(seq.prompt)) != bucket:
+                    break  # different compile bucket: next step's group
+                slot = next((i for i, s in enumerate(self._slots)
+                             if s is None and i not in taken), None)
+                if slot is None:
+                    break
+                pages = self.allocator.alloc(
+                    seq.pages_needed(self.page_size, headroom=1))
+                if pages is None:
+                    break  # no memory: wait for a finish to free pages
+                self.waiting.pop(0)
+                taken.append(slot)
+                group.append((seq, slot, pages))
+        if not group:
+            return
+        Tpad = bucket
+        self.stats["prefill_dispatches"] += 1
+        if len(group) == 1:
+            seq, slot, pages = group[0]
+            T = len(seq.prompt)
+            tokens = np.zeros((1, Tpad), np.int32)
+            tokens[0, :T] = seq.prompt
+            logits, k_all, v_all = prefill(
+                self.params, jnp.asarray(tokens), jnp.int32(T), self.cfg)
+            self._postfill(seq, slot, pages, int(jnp.argmax(logits)),
+                           k_all, v_all)
+            return
+        # batched path: pad the group to a power-of-two size so compile
+        # count stays |size buckets| x |length buckets|, not one program
+        # per exact group size
+        N = len(group)
+        Npad = _bucket(N, lo=1)
+        tokens = np.zeros((Npad, Tpad), np.int32)
+        lens = np.ones(Npad, np.int32)
+        for i, (seq, _, _) in enumerate(group):
+            tokens[i, :len(seq.prompt)] = seq.prompt
+            lens[i] = len(seq.prompt)
+        logits_n, k_n, v_n = prefill_many(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens), self.cfg)
+        # ONE blocking readback for the whole group's first tokens; the
+        # per-sequence KV writes below are async dispatches, so the group
+        # costs ~2 host round-trips instead of 2N
+        first_toks = np.asarray(jnp.argmax(logits_n, axis=-1))
+        for i, (seq, slot, pages) in enumerate(group):
+            self._postfill(seq, slot, pages, int(first_toks[i]),
+                           k_n[i], v_n[i])
+
+    def _postfill(self, seq: SequenceState, slot: int, pages: List[int],
+                  first_tok: int, k_all, v_all) -> None:
+        """Per-sequence bookkeeping after its prompt forward pass: write
+        K/V into the sequence's pages (async dispatch), then either
+        finish immediately (EOS / 1-token budget) or join the decode
+        batch with the already-sampled first token."""
         T = len(seq.prompt)
-        Tpad = _bucket(T)
-        tokens = np.zeros((1, Tpad), np.int32)
-        tokens[0, :T] = seq.prompt
-        logits, k_all, v_all = prefill(self.params, jnp.asarray(tokens),
-                                       jnp.int32(T), self.cfg)
-        Tpage = n_pages * self.page_size
+        Tpage = len(pages) * self.page_size
         pages_arr = jnp.asarray(pages, jnp.int32)
         self.k_cache, self.v_cache = _write_prefill_pages(
             self.k_cache, self.v_cache, k_all, v_all, jnp.int32(T),
             pages_arr, Tpage)
-        first_tok = int(jnp.argmax(logits))
         seq.pages = pages
         self.stats["prefill_tokens"] += T
         done_now = seq.max_new_tokens <= 1 \

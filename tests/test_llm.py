@@ -141,3 +141,43 @@ def test_page_allocator():
     assert a.alloc(10) is None
     a.free(got)
     assert a.num_free == 7
+
+
+def test_batched_prefill_group_matches_oracle(params):
+    """Same-bucket prompts admit as ONE batched prefill dispatch and
+    still reproduce each prompt's solo greedy output exactly."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=128,
+                          max_batch=4, max_seq_len=128, prefill_batch=4)
+    # all in the 16-bucket (lengths 9..16) -> one group of 3 (padded to 4)
+    prompts = [[7 + i for i in range(12)],
+               [40 + i for i in range(10)],
+               [90 + i for i in range(15)]]
+    solo = [_oracle_greedy(params, p, 6) for p in prompts]
+    rids = [eng.add_request(p, 6) for p in prompts]
+    results = dict(eng.step())   # one step admits the whole group
+    assert eng.stats["prefill_dispatches"] == 1, \
+        "three same-bucket prompts should ride ONE prefill dispatch"
+    for _ in range(100):
+        if len(results) == len(rids):
+            break
+        results.update(eng.step())
+    for rid, want in zip(rids, solo):
+        assert results[rid] == want, f"{rid}: {results[rid]} vs {want}"
+
+
+def test_batched_prefill_mixed_buckets_split(params):
+    """A different-bucket prompt at the group boundary waits for the
+    next step's group instead of forcing a bigger pad."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=128,
+                          max_batch=4, max_seq_len=128, prefill_batch=4)
+    short = [5, 6, 7]                     # 16-bucket (min bucket is 16)
+    long = [20 + i for i in range(20)]    # 32-bucket
+    solo = [_oracle_greedy(params, p, 5) for p in (short, long)]
+    rids = [eng.add_request(short, 5), eng.add_request(long, 5)]
+    results = {}
+    for _ in range(100):
+        results.update(eng.step())
+        if len(results) == 2:
+            break
+    for rid, want in zip(rids, solo):
+        assert results[rid] == want
