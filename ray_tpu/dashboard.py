@@ -3,9 +3,11 @@
 Role-equivalent (minimal) to the reference's dashboard head (reference:
 dashboard/head.py + http_server_head.py + state_aggregator.py): a JSON
 REST server over the head's state/metrics/timeline/jobs tables plus a
-single-page HTML summary. The reference's React frontend, per-node
-agents, and Grafana integration are out of scope — the data surface is
-what the judge's `ray list`/state-API parity needs.
+single-page HTML summary, plus the reference's per-node agent surface
+(node stats from /proc, on-demand worker stack profiles) served by the
+node daemons directly instead of separate agent processes. The React
+frontend and Grafana integration are out of scope — the data surface is
+what the `ray list`/state-API parity needs.
 
 Endpoints:
   GET /            html summary
@@ -13,6 +15,11 @@ Endpoints:
   GET /api/metrics aggregated metrics
   GET /api/timeline task spans (chrome-trace convertible)
   GET /api/jobs    submitted jobs
+  GET /api/nodes   per-node agent stats (cpu/mem/disk/store/worker RSS —
+                   the reference's reporter-agent surface)
+  GET /api/profile?node_id=N&worker_id=W
+                   on-demand stack dump of one worker (the reference's
+                   py-spy role, served by the worker in-process)
 """
 
 from __future__ import annotations
@@ -22,7 +29,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from ray_tpu.runtime.protocol import RpcClient
+from ray_tpu.runtime.protocol import ClientPool, RpcClient
 
 _PAGE = """<!doctype html><title>ray_tpu dashboard</title>
 <style>body{font-family:monospace;margin:2em}td,th{padding:2px 8px;
@@ -48,6 +55,8 @@ class Dashboard:
     def __init__(self, head_addr: str, port: int = 0):
         client = RpcClient(head_addr, name="dashboard")
         self._client = client
+        pool = ClientPool(name="dash->node")   # persistent per-node conns
+        self._pool = pool
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -62,10 +71,62 @@ class Dashboard:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _node_addr(self, node_id: str):
+                nodes = client.call("list_nodes", timeout=10)
+                for n in nodes:
+                    if n["node_id"].startswith(node_id) and n["alive"]:
+                        return n["address"]
+                raise ValueError(f"no live node matching {node_id!r}")
+
             def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
                 try:
                     if self.path in ("/", "/index.html"):
                         self._send(200, _PAGE.encode(), "text/html")
+                        return
+                    parsed = urlparse(self.path)
+                    if parsed.path == "/api/nodes":
+                        # fan out: one hung-but-alive node must not
+                        # stall the endpoint for 10s x N
+                        nodes = client.call("list_nodes", timeout=10)
+                        futs = {}
+                        for n in nodes:
+                            if n["alive"]:
+                                try:
+                                    futs[n["node_id"]] = pool.get(
+                                        n["address"]).call_async(
+                                            "node_stats")
+                                except Exception as e:  # noqa: BLE001
+                                    futs[n["node_id"]] = e
+                        data = []
+                        for n in nodes:
+                            row = dict(n)
+                            fut = futs.get(n["node_id"])
+                            if fut is not None:
+                                try:
+                                    row["stats"] = fut.result(timeout=10) \
+                                        if not isinstance(fut, Exception) \
+                                        else {"error": repr(fut)}
+                                except Exception as e:  # noqa: BLE001
+                                    row["stats"] = {"error": repr(e)}
+                            data.append(row)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
+                    if parsed.path == "/api/profile":
+                        q = parse_qs(parsed.query)
+                        if not q.get("node_id") or not q.get("worker_id"):
+                            self._send(400, json.dumps(
+                                {"error": "need node_id and worker_id "
+                                          "query params"}).encode(),
+                                "application/json")
+                            return
+                        addr = self._node_addr(q["node_id"][0])
+                        data = pool.get(addr).call(
+                            "profile_worker",
+                            {"worker_id": q["worker_id"][0]}, timeout=15)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
                         return
                     if self.path == "/api/state":
                         data = client.call("state_dump", timeout=10)
@@ -104,3 +165,4 @@ class Dashboard:
     def stop(self) -> None:
         self._server.shutdown()
         self._client.close()
+        self._pool.close_all()

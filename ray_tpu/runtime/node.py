@@ -97,6 +97,8 @@ class NodeDaemon:
             "read_chunk": self._h_read_chunk,
             "delete_object": self._h_delete_object,
             "store_stats": lambda p, c: self.store.stats(),
+            "node_stats": self._h_node_stats,
+            "profile_worker": self._h_profile_worker,
             "list_workers": self._h_list_workers,
             "worker_fate": self._h_worker_fate,
             "ping": lambda p, c: "pong",
@@ -592,6 +594,55 @@ class NodeDaemon:
             return [{"worker_id": w.worker_id.hex(), "state": w.state,
                      "address": w.address, "pid": w.proc.pid}
                     for w in self._workers.values()]
+
+    def _h_node_stats(self, p, ctx):
+        """psutil-style node report: cpu load, memory, disk, per-worker
+        RSS — the reference's per-node reporter agent surface
+        (dashboard/agent.py + reporter_agent.py), served straight from
+        /proc instead of a separate agent process."""
+        mem = self._node_memory()
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = None
+        import shutil
+        from ray_tpu.runtime.object_plane import spill_dir_for
+        spill = spill_dir_for(config_mod.GlobalConfig.session_dir,
+                              self.shm_name)
+        try:
+            du = shutil.disk_usage(spill if os.path.isdir(spill) else "/")
+            disk = {"total": du.total, "used": du.used, "free": du.free}
+        except OSError:
+            disk = None
+        with self._lock:
+            workers = [{"worker_id": w.worker_id.hex(), "state": w.state,
+                        "pid": w.proc.pid,
+                        "rss": self._rss_bytes(w.proc.pid)}
+                       for w in self._workers.values()]
+        return {
+            "node_id": self.node_id,
+            "cpus": os.cpu_count(),
+            "load_avg": [load1, load5, load15],
+            "mem_available": mem[0] if mem else None,
+            "mem_total": mem[1] if mem else None,
+            "disk": disk,
+            "store": self.store.stats(),
+            "workers": workers,
+        }
+
+    def _h_profile_worker(self, p, ctx):
+        """On-demand stack dump of one worker (reference: dashboard
+        reporter's py-spy profile_manager role): forwards to the worker's
+        dump_stacks RPC."""
+        wid = p["worker_id"]
+        if isinstance(wid, str):
+            wid = bytes.fromhex(wid)
+        with self._lock:
+            w = self._workers.get(wid)
+            addr = w.address if w is not None else None
+        if addr is None:
+            raise ValueError(f"no live worker {wid.hex()} on this node")
+        return self._clients.get(addr).call("dump_stacks", timeout=10.0)
 
     # ----------------------------------------------------------- object plane
 

@@ -564,6 +564,25 @@ def pickle_loads(data: bytes):
     return pickle.loads(data)
 
 
+def _dump_stacks() -> dict:
+    """All thread stacks of this worker, formatted — the in-process
+    analog of the reference's on-demand py-spy profiling
+    (dashboard/modules/reporter/profile_manager.py:82): no external
+    profiler binary exists in the image, but sys._current_frames gives
+    the same "where is this worker stuck" answer."""
+    import traceback
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for ident, frame in frames.items():
+        # key by name AND ident: same-named threads (e.g. pooled client
+        # readers) must not overwrite each other in the report
+        key = f"{names.get(ident, 'thread')}-{ident}"
+        stacks[key] = "".join(traceback.format_stack(frame))
+    return {"pid": os.getpid(), "num_threads": len(stacks),
+            "stacks": stacks}
+
+
 def main() -> None:
     node_addr, head_addr, shm_name, worker_hex, cfg_json = sys.argv[1:6]
     config_mod.GlobalConfig.apply(json.loads(cfg_json))
@@ -603,6 +622,7 @@ def main() -> None:
         "become_actor": executor.handle_become_actor,
         "cancel_task": executor.handle_cancel,
         "ping": lambda p, c: "pong",
+        "dump_stacks": lambda p, c: _dump_stacks(),
         "exit": lambda p, c: os._exit(0),
     })
     backend.server.inline_methods.add("push_task")

@@ -48,3 +48,51 @@ def test_dashboard_endpoints(cluster_rt):
             assert json.loads(r.read()) == []
     finally:
         dash.stop()
+
+
+def test_node_stats_and_profile(cluster_rt):
+    """Per-node agent stats + on-demand worker stack dump (reference:
+    dashboard agent reporter + py-spy profile_manager roles)."""
+    import time
+
+    @rt.remote
+    class Sleeper:
+        def busy_wait(self, s):
+            time.sleep(s)
+            return "done"
+
+    a = Sleeper.remote()
+    assert rt.get(a.busy_wait.remote(0.0), timeout=60) == "done"  # ready
+    ref = a.busy_wait.remote(8.0)   # a clearly-identifiable stack to find
+
+    dash = Dashboard(global_worker.backend.head_addr)
+    base = f"http://127.0.0.1:{dash.port}"
+    try:
+        rows = json.loads(urllib.request.urlopen(
+            f"{base}/api/nodes", timeout=30).read())
+        live = [r for r in rows if r["alive"]]
+        assert live, rows
+        st = live[0]["stats"]
+        assert st["cpus"] >= 1 and st["mem_total"] > 0
+        assert "store" in st and "capacity" in st["store"]
+        workers = [w for w in st["workers"] if w["rss"]]
+        assert workers, st["workers"]
+
+        # profile actor workers: ONE of them (the Sleeper, not any
+        # earlier test's actor) must show busy_wait on a stack
+        actor_workers = [w for w in st["workers"]
+                         if w["state"] == "actor"]
+        assert actor_workers, st["workers"]
+        found = False
+        for w in actor_workers:
+            prof = json.loads(urllib.request.urlopen(
+                f"{base}/api/profile?node_id={live[0]['node_id']}"
+                f"&worker_id={w['worker_id']}", timeout=30).read())
+            assert prof["num_threads"] >= 1
+            if "busy_wait" in "\n".join(prof["stacks"].values()):
+                found = True
+        assert found, "no actor worker stack showed busy_wait"
+    finally:
+        dash.stop()
+    # OUTSIDE finally: a drain failure must not mask the real assertion
+    assert rt.get(ref, timeout=60) == "done"
