@@ -8,9 +8,10 @@ ops/paged_attention.py is the decode kernel, llm/engine.py the
 continuous-batching loop, llm/serve_llm.py the serve deployment.
 """
 
+from ray_tpu.llm.batch import LLMBatchPredictor, batch_inference
 from ray_tpu.llm.cache import PageAllocator, make_kv_cache
 from ray_tpu.llm.engine import InferenceEngine
 from ray_tpu.llm.serve_llm import LLMServer
 
 __all__ = ["InferenceEngine", "LLMServer", "PageAllocator",
-           "make_kv_cache"]
+           "make_kv_cache", "batch_inference", "LLMBatchPredictor"]
