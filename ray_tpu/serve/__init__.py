@@ -27,7 +27,7 @@ from ray_tpu.serve.multiplex import multiplexed, get_multiplexed_model_id
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "delete", "Deployment", "Application", "DeploymentHandle",
-    "DeploymentResponse", "start_http_proxy", "batch",
+    "DeploymentResponse", "start_http_proxy", "start_grpc_proxy", "batch",
     "multiplexed", "get_multiplexed_model_id",
 ]
 
@@ -195,6 +195,14 @@ def start_http_proxy(port: int = 0) -> int:
     """Ensure the HTTP ingress is up; returns the bound port."""
     controller = _get_or_start_controller()
     return ray_tpu.get(controller.ensure_proxy.remote(port), timeout=60)
+
+
+def start_grpc_proxy(port: int = 0):
+    """Start a gRPC ingress in THIS process; returns the GrpcIngress
+    (``.port``, ``.stop()``). JSON-bytes generic methods — see
+    serve/grpc_proxy.py (reference: serve gRPC proxy)."""
+    from ray_tpu.serve.grpc_proxy import GrpcIngress
+    return GrpcIngress(_get_or_start_controller(), port=port)
 
 
 def shutdown() -> None:

@@ -1,0 +1,113 @@
+"""gRPC ingress for serve deployments.
+
+Role-equivalent to the reference's serve gRPC proxy (reference:
+serve/_private/proxy.py:752 gRPC side + serve gRPC service configs):
+a real grpc.Server exposing two generic methods —
+
+    /raytpu.serve.Ingress/Call     unary-unary
+    /raytpu.serve.Ingress/Stream   unary-stream (one message per yielded
+                                   item from a streaming deployment)
+
+Payloads are JSON bytes (no .proto codegen exists in this image, and the
+reference's arbitrary-proto passthrough reduces to bytes-in/bytes-out
+anyway): request {"app", "method"?, "body"?, "multiplexed_model_id"?},
+reply {"result": ...} per message. Any grpc client can reach it with
+channel.unary_unary("/raytpu.serve.Ingress/Call") — no generated stubs
+required.
+
+Routing rides the SAME DeploymentHandle path as the HTTP proxy (pow-2
+choice, multiplexing affinity, streaming generators), so the two
+ingresses cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+SERVICE = "raytpu.serve.Ingress"
+
+
+class GrpcIngress:
+    def __init__(self, controller, port: int = 0, max_workers: int = 8):
+        import grpc
+        from concurrent import futures
+
+        from ray_tpu.serve.router import HandleCache
+        self._controller = controller
+        self._handles = HandleCache(controller)
+
+        def parse(data: bytes) -> dict:
+            req = json.loads(data or b"{}")
+            if not isinstance(req, dict) or "app" not in req:
+                raise ValueError('request JSON needs an "app" field')
+            t = req.get("timeout_s", 60.0)
+            if not isinstance(t, (int, float)) or not (0 < t <= 600):
+                # null/strings/absurd values must not park a pool thread
+                # forever — 8 such requests would wedge the ingress
+                raise ValueError(
+                    f"timeout_s must be a number in (0, 600], got {t!r}")
+            req["timeout_s"] = float(t)
+            return req
+
+        def resolve(req: dict):
+            handle = self._handles.get(req["app"])
+            method = req.get("method")
+            if method:
+                if method.startswith("_"):
+                    raise KeyError(method)
+                handle = getattr(handle, method)
+            mux = req.get("multiplexed_model_id", "")
+            if mux:
+                handle = handle.options(multiplexed_model_id=mux)
+            return handle
+
+        def call(data: bytes, context) -> bytes:
+            try:
+                req = parse(data)
+                handle = resolve(req)
+                args = () if "body" not in req else (req["body"],)
+                result = handle.remote(*args).result(
+                    timeout=req["timeout_s"])
+                return json.dumps({"result": result},
+                                  default=str).encode()
+            except (ValueError, KeyError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+            except Exception as e:  # noqa: BLE001 — app fault boundary
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        def stream(data: bytes, context):
+            try:
+                req = parse(data)
+                handle = resolve(req).options(stream=True)
+                args = () if "body" not in req else (req["body"],)
+                for item in handle.remote(*args):
+                    yield json.dumps({"result": item},
+                                     default=str).encode()
+            except (ValueError, KeyError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        raw = (lambda b: b, lambda b: b)  # bytes passthrough (de)serializer
+        handler = grpc.method_handlers_generic_handler(SERVICE, {
+            "Call": grpc.unary_unary_rpc_method_handler(
+                call, request_deserializer=raw[0],
+                response_serializer=raw[1]),
+            "Stream": grpc.unary_stream_rpc_method_handler(
+                stream, request_deserializer=raw[0],
+                response_serializer=raw[1]),
+        })
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="serve-grpc"))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        if self.port == 0:
+            # grpc signals bind failure by returning port 0 — surface it
+            # here instead of handing back a server that listens nowhere
+            raise OSError(f"gRPC ingress failed to bind 127.0.0.1:{port}")
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self._server.stop(grace)

@@ -13,7 +13,8 @@ rebuilt on the stdlib ThreadingHTTPServer (no external deps):
    deployment named by the body's ``"model"`` field (reference:
    llm/_internal/serve/deployments/routers/router.py).
 
-gRPC ingress is out of scope.
+The gRPC ingress lives in serve/grpc_proxy.py and shares this module's
+handle-resolution path (router.HandleCache).
 """
 
 from __future__ import annotations
@@ -21,14 +22,15 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict
 
 
 class HTTPProxy:
     def __init__(self, controller, port: int = 0):
+        from ray_tpu.serve.router import HandleCache
         self._controller = controller
-        self._handles: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        # shared with the gRPC ingress so the two routing paths can't
+        # drift (handle cache + controller liveness probe on miss)
+        self._handles = HandleCache(controller)
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -167,20 +169,7 @@ class HTTPProxy:
         self._thread.start()
 
     def _handle_for(self, name: str):
-        with self._lock:
-            h = self._handles.get(name)
-        if h is not None:
-            return h
-        import ray_tpu
-        live = ray_tpu.get(self._controller.list_deployments.remote(),
-                           timeout=10)
-        if name not in live:
-            raise KeyError(name)
-        from ray_tpu.serve.router import DeploymentHandle
-        h = DeploymentHandle(self._controller, name)
-        with self._lock:
-            self._handles[name] = h
-        return h
+        return self._handles.get(name)
 
     def bound_port(self) -> int:
         return self._port

@@ -295,6 +295,31 @@ class Router:
                 self._pending = still
 
 
+class HandleCache:
+    """Deployment-name -> DeploymentHandle cache with a controller
+    liveness probe on miss — shared by the HTTP and gRPC ingresses so
+    their routing paths cannot drift."""
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._lock = threading.Lock()
+        self._handles: Dict[str, "DeploymentHandle"] = {}
+
+    def get(self, name: str) -> "DeploymentHandle":
+        with self._lock:
+            h = self._handles.get(name)
+        if h is not None:
+            return h
+        live = ray_tpu.get(self._controller.list_deployments.remote(),
+                           timeout=10)
+        if name not in live:
+            raise KeyError(name)
+        h = DeploymentHandle(self._controller, name)
+        with self._lock:
+            self._handles[name] = h
+        return h
+
+
 class DeploymentHandle:
     """User-facing handle; ``h.remote(...)`` calls __call__ on a replica,
     ``h.method.remote(...)`` calls a named method."""

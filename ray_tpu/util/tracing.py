@@ -1,0 +1,110 @@
+"""OpenTelemetry export of task spans (OTLP/JSON, dependency-free).
+
+Role-equivalent to the reference's tracing integration (reference:
+python/ray/util/tracing/ — OTel instrumentation of task/actor calls
+exported through a user-configured exporter): the head already collects
+per-task spans (runtime/events.py → timeline); this module converts them
+to the OTLP JSON schema (`resourceSpans` → `scopeSpans` → `spans`, the
+wire format every OTel collector accepts on /v1/traces) WITHOUT the OTel
+SDK, which this image doesn't ship — the schema is public and plain
+dicts suffice.
+
+    from ray_tpu.util import tracing
+    tracing.export_otlp_file("spans.json")          # one-shot snapshot
+    tracing.post_otlp("http://collector:4318/v1/traces")  # OTLP/HTTP
+
+Span ids are derived deterministically from (task_id, start), so
+re-exports of overlapping snapshots produce identical ids and a
+collector dedups instead of double-counting.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.worker import require_connected
+
+SERVICE_NAME = "ray_tpu"
+
+
+def _span_ids(e: Dict[str, Any]) -> tuple:
+    """(trace_id_hex32, span_id_hex16): trace groups by task lineage —
+    the task id IS the natural trace key; span id folds in the start
+    time so retries of one task become distinct spans on one trace."""
+    tid = hashlib.sha256(
+        ("trace:" + e.get("task_id", "")).encode()).hexdigest()[:32]
+    sid = hashlib.sha256(
+        f"span:{e.get('task_id', '')}:{e.get('start', 0)}".encode()
+    ).hexdigest()[:16]
+    return tid, sid
+
+
+def events_to_otlp(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Task events → one OTLP/JSON ExportTraceServiceRequest dict."""
+    spans = []
+    for e in events:
+        if e.get("kind") == "meta":
+            continue
+        trace_id, span_id = _span_ids(e)
+        spans.append({
+            "traceId": trace_id,
+            "spanId": span_id,
+            "name": e.get("name", "task"),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(int(e["start"] * 1e9)),
+            "endTimeUnixNano": str(int(e["end"] * 1e9)),
+            "status": {"code": 1 if e.get("ok") else 2},
+            "attributes": [
+                {"key": "rtpu.task_id",
+                 "value": {"stringValue": e.get("task_id", "")}},
+                {"key": "rtpu.kind",
+                 "value": {"stringValue": e.get("kind", "task")}},
+                {"key": "rtpu.worker",
+                 "value": {"stringValue": str(e.get("worker", ""))}},
+            ],
+        })
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": SERVICE_NAME}}]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.tasks"},
+                "spans": spans,
+            }],
+        }],
+    }
+
+
+def _fetch_events() -> List[Dict[str, Any]]:
+    worker = require_connected()
+    head = getattr(worker.backend, "head", None)
+    if head is None:
+        return []  # local mode keeps no cluster timeline
+    return head.call_retrying("timeline_dump") or []
+
+
+def export_otlp_file(path: str) -> int:
+    """Snapshot the cluster's task spans to an OTLP/JSON file; returns
+    the span count (feed the file to any collector or to Jaeger's OTLP
+    JSON import)."""
+    payload = events_to_otlp(_fetch_events())
+    n = len(payload["resourceSpans"][0]["scopeSpans"][0]["spans"])
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return n
+
+
+def post_otlp(endpoint: str,
+              timeout_s: float = 10.0) -> Optional[int]:
+    """POST the current task spans to an OTLP/HTTP collector
+    (e.g. http://host:4318/v1/traces). Returns the HTTP status."""
+    import urllib.request
+    payload = events_to_otlp(_fetch_events())
+    req = urllib.request.Request(
+        endpoint, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return r.status
