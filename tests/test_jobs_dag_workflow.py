@@ -158,3 +158,38 @@ def test_job_failure_surfaces(cluster_rt):
     job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
     assert client.wait(job_id, timeout=120) == FAILED
     assert "exit code 3" in client.get_job_info(job_id)["message"]
+
+
+def test_compiled_dag_fuses_to_one_program(cluster_rt):
+    """experimental_compile: the whole bound graph becomes ONE jitted
+    XLA program whose result matches the task-path execution exactly
+    (reference: dag/compiled_dag_node.py aDAG role)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.dag import InputNode, experimental_compile, \
+        execute_with_input
+
+    @rt.remote
+    def scale(x):
+        return x * 2.0
+
+    @rt.remote
+    def shift(x):
+        return x + 1.0
+
+    @rt.remote
+    def combine(a, b):
+        return a * b          # diamond: both branches from one input
+
+    with InputNode() as inp:
+        dag = combine.bind(scale.bind(inp), shift.bind(inp))
+
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    compiled = experimental_compile(dag)
+    fused = compiled.execute(x)                       # no tasks at all
+    via_tasks = rt.get(execute_with_input(dag, np.asarray(x)), timeout=60)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(via_tasks),
+                               rtol=1e-6)
+    # repeat executions reuse the compiled program (fast path exists)
+    np.testing.assert_allclose(np.asarray(compiled.execute(x * 2)),
+                               np.asarray(x * 2) * 2 * (np.asarray(x * 2) + 1))
