@@ -33,7 +33,7 @@ class GrpcIngress:
         import grpc
         from concurrent import futures
 
-        from ray_tpu.serve.router import HandleCache
+        from ray_tpu.serve.router import HandleCache, validate_timeout_s
         self._controller = controller
         self._handles = HandleCache(controller)
 
@@ -41,13 +41,9 @@ class GrpcIngress:
             req = json.loads(data or b"{}")
             if not isinstance(req, dict) or "app" not in req:
                 raise ValueError('request JSON needs an "app" field')
-            t = req.get("timeout_s", 60.0)
-            if not isinstance(t, (int, float)) or not (0 < t <= 600):
-                # null/strings/absurd values must not park a pool thread
-                # forever — 8 such requests would wedge the ingress
-                raise ValueError(
-                    f"timeout_s must be a number in (0, 600], got {t!r}")
-            req["timeout_s"] = float(t)
+            # a null/absurd deadline must not park a pool thread forever
+            # — 8 such requests would wedge the ingress
+            req["timeout_s"] = validate_timeout_s(req.get("timeout_s"))
             return req
 
         def resolve(req: dict):

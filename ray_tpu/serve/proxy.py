@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
 
 
 class HTTPProxy:
@@ -90,11 +91,23 @@ class HTTPProxy:
                     if mux_id:
                         handle = handle.options(
                             multiplexed_model_id=mux_id)
+                    # client-supplied deadline, same policy as the gRPC
+                    # ingress (a cold LLM replica's first compile can
+                    # exceed the 60s default on busy hosts); invalid
+                    # values are a 400, not a silently-ignored deadline
+                    from ray_tpu.serve.router import validate_timeout_s
+                    try:
+                        timeout_s = validate_timeout_s(
+                            body.get("timeout_s")
+                            if isinstance(body, dict) else None)
+                    except ValueError as e:
+                        self._reply(400, {"error": str(e)})
+                        return
                     if body is None:
                         resp = handle.remote()
                     else:
                         resp = handle.remote(body)
-                    result = resp.result(timeout=60.0)
+                    result = resp.result(timeout=timeout_s)
                     # OpenAI clients read top-level id/choices — no wrapper
                     self._reply(200, result if openai
                                 else {"result": result})

@@ -295,6 +295,20 @@ class Router:
                 self._pending = still
 
 
+def validate_timeout_s(value, default: float = 60.0) -> float:
+    """Shared ingress deadline policy: a number in (0, 600], default
+    when absent. Raises ValueError on anything else — silently falling
+    back would ignore the client's stated deadline. bool is excluded
+    explicitly (it passes isinstance(int) and true would mean 1s)."""
+    if value is None:
+        return default
+    if isinstance(value, bool) or not isinstance(value, (int, float)) \
+            or not (0 < value <= 600):
+        raise ValueError(
+            f"timeout_s must be a number in (0, 600], got {value!r}")
+    return float(value)
+
+
 class HandleCache:
     """Deployment-name -> DeploymentHandle cache with a controller
     liveness probe on miss — shared by the HTTP and gRPC ingresses so

@@ -96,7 +96,7 @@ def test_openai_completions_http(stream_rt):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/v1/completions",
         data=json.dumps({"model": "tinyllm", "prompt": "hello tpu",
-                         "max_tokens": 8}).encode(),
+                         "max_tokens": 8, "timeout_s": 240}).encode(),
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=300) as resp:
         body = json.loads(resp.read())  # OpenAI shape: NOT wrapped
