@@ -17,6 +17,7 @@ LIB_PATH = os.path.join(_THIS_DIR, "libray_tpu_native.so")
 SOURCES = [
     "shm_store.cc",
     "scheduler.cc",
+    "transport.cc",
 ]
 
 CXXFLAGS = [

@@ -75,6 +75,18 @@ class _PendingTask:
         self.attempts = 0
 
 
+class _BatchState:
+    """In-flight batch of tasks pushed to one lease in a single frame."""
+
+    __slots__ = ("lease", "tasks", "remaining", "failed")
+
+    def __init__(self, lease: _Lease, tasks: list):
+        self.lease = lease
+        self.tasks = tasks
+        self.remaining = len(tasks)
+        self.failed: list = []  # (task, exc) — handled when batch drains
+
+
 class _TaskSubmitter:
     """Lease-cached pipelined submission for one resource shape."""
 
@@ -143,9 +155,15 @@ class _TaskSubmitter:
                         spawn = max(0, want - self.requesting)
                         self.requesting += spawn
                     break
-                task = self.pending.popleft()
+                # Exactly ONE task per lease at a time: a lease is a
+                # concurrency slot, and packing queued tasks onto it would
+                # serialize work that belongs on other workers (verified
+                # regression: 4 sleeping tasks ran serially on one worker).
+                # Actor submitters batch instead — actor calls are serial
+                # by contract. Transport-level coalescing still applies.
+                tasks = [self.pending.popleft()]
                 lease.busy = True
-            self._push(lease, task)
+            self._push_batch(lease, tasks)
         for _ in range(spawn):
             threading.Thread(target=self._request_lease, daemon=True,
                              name="lease-req").start()
@@ -223,30 +241,48 @@ class _TaskSubmitter:
         for t in tasks:
             self.backend._store_task_error(t.spec, exc, t.pins)
 
-    def _push(self, lease: _Lease, task: _PendingTask) -> None:
-        task.attempts += 1
+    def _push_batch(self, lease: _Lease, tasks: list) -> None:
+        for t in tasks:
+            t.attempts += 1
+        state = _BatchState(lease, tasks)
         client = self.backend.peers.get(lease.worker_addr)
-        fut = client.call_async("push_task", task.payload)
-        fut.add_done_callback(
-            lambda f: self._on_reply(lease, task, f))
+        client.call_batch_cb("push_task", [t.payload for t in tasks],
+                             lambda i, v, e: self._on_reply(state, i, v, e))
 
-    def _on_reply(self, lease: _Lease, task: _PendingTask, fut) -> None:
-        exc = fut.exception()
+    def _on_reply(self, state: _BatchState, i: int, value,
+                  exc: Optional[BaseException]) -> None:
+        task = state.tasks[i]
         if exc is None:
-            self.backend._store_task_reply(task.spec, fut.result(), task.pins)
-            with self.lock:
-                lease.busy = False
-                lease.idle_since = time.monotonic()
-            self._pump()
-            return
-        # transport failure: the leased worker is gone (crash/chaos).
-        self._drop_lease(lease)
-        if isinstance(exc, RpcError) and task.attempts <= task.spec.max_retries:
-            with self.lock:
-                self.pending.appendleft(task)
-            self._pump()
+            self.backend._store_task_reply(task.spec, value, task.pins)
         else:
-            fate = self._worker_fate(lease)
+            state.failed.append((task, exc))
+        with self.lock:
+            state.remaining -= 1
+            done = state.remaining == 0
+            if done and not state.failed:
+                state.lease.busy = False
+                state.lease.idle_since = time.monotonic()
+        if not done:
+            return
+        if state.failed:
+            # Transport failure: the leased worker is gone (crash/chaos).
+            # Handled on a fresh thread: this callback runs on the transport
+            # dispatcher, and the failure path makes blocking RPCs
+            # (release_lease / worker_fate) the dispatcher must not wait on.
+            threading.Thread(target=self._on_push_failed, args=(state,),
+                             daemon=True, name="push-fail").start()
+        else:
+            self._pump()
+
+    def _on_push_failed(self, state: _BatchState) -> None:
+        self._drop_lease(state.lease)
+        retry = []
+        for task, exc in state.failed:
+            if isinstance(exc, RpcError) and \
+                    task.attempts <= task.spec.max_retries:
+                retry.append(task)
+                continue
+            fate = self._worker_fate(state.lease)
             if fate == "oom":
                 err: BaseException = OutOfMemoryError(
                     f"worker was OOM-killed running {task.spec.name} "
@@ -257,6 +293,11 @@ class _TaskSubmitter:
                     f"worker died running {task.spec.name} "
                     f"(attempt {task.attempts}): {exc}")
             self.backend._store_task_error(task.spec, err, task.pins)
+        if retry:
+            with self.lock:
+                # preserve original submission order at the queue front
+                self.pending.extendleft(reversed(retry))
+        self._pump()
 
     def _worker_fate(self, lease: _Lease) -> Optional[str]:
         """Ask the worker's node daemon WHY it died (the submitter only
@@ -448,17 +489,22 @@ class _ActorSubmitter:
                     return
                 self._flushing = True
             try:
+                batch_max = config_mod.GlobalConfig.task_push_batch
                 while True:
                     with self.lock:
                         if self.state != "ALIVE" or not self.pending:
                             break
-                        task = self.pending.popleft()
+                        tasks = [self.pending.popleft() for _ in
+                                 range(min(len(self.pending), batch_max))]
                         addr = self.address
-                    task.attempts += 1
+                    for t in tasks:
+                        t.attempts += 1
                     client = self.backend.peers.get(addr)
-                    fut = client.call_async("push_task", task.payload)
-                    fut.add_done_callback(
-                        lambda f, t=task: self._on_reply(t, f))
+                    # one frame for the whole run of queued calls; the
+                    # actor executes them in seq order either way
+                    client.call_batch_cb(
+                        "push_task", [t.payload for t in tasks],
+                        lambda i, v, e, ts=tasks: self._on_reply(ts[i], v, e))
             finally:
                 with self.lock:
                     self._flushing = False
@@ -467,10 +513,10 @@ class _ActorSubmitter:
                     return
                 # work arrived while we were clearing the flag — go again
 
-    def _on_reply(self, task: _PendingTask, fut) -> None:
-        exc = fut.exception()
+    def _on_reply(self, task: _PendingTask, value,
+                  exc: Optional[BaseException]) -> None:
         if exc is None:
-            self.backend._store_task_reply(task.spec, fut.result(), task.pins)
+            self.backend._store_task_reply(task.spec, value, task.pins)
             return
         # connection to the actor broke: restart-aware handling
         # (reference: ActorTaskSubmitter disconnect path + max_task_retries,

@@ -702,8 +702,17 @@ class Head:
         with self._lock:
             stale = [l.lease_id for l in self._leases.values()
                      if l.peer == peer]
-        for lease_id in stale:
-            self._h_release_lease({"lease_id": lease_id}, None)
+        if not stale:
+            return
+
+        # off-thread: this callback runs on the transport dispatcher and
+        # _h_release_lease makes a blocking return_worker call per lease
+        def _reclaim():
+            for lease_id in stale:
+                self._h_release_lease({"lease_id": lease_id}, None)
+
+        threading.Thread(target=_reclaim, daemon=True,
+                         name="lease-reclaim").start()
 
     def _h_release_lease(self, p, ctx):
         with self._lock:

@@ -568,20 +568,16 @@ class ObjectPlane:
         with self._lock:
             secondaries = self.secondary.pop(object_id, set())
         secondaries.discard(node_id)
-        if node_id is not None:
-            try:
-                self.node_client(node_id).call(
-                    "delete_object", {"object_id": key}, timeout=5.0)
-            except (RpcError, ObjectLostError):
-                pass
-        for n in secondaries:
-            # cache copies are unpinned/LRU-evictable; eager delete just
-            # frees the arena sooner
-            try:
-                self.node_client(n).call(
-                    "delete_object", {"object_id": key}, timeout=2.0)
-            except (RpcError, ObjectLostError):
-                pass
+        # Oneway + no node-map refresh: deletes are best-effort (errors
+        # were swallowed even as unary calls) and this path runs inside
+        # reply callbacks on the transport dispatcher thread, which must
+        # never block on an RPC (node_client's refresh path calls the
+        # head). A node missing from the map is gone — its copy with it.
+        for n in ([node_id] if node_id is not None else []) + list(secondaries):
+            addr = self.node_addrs.get(n)
+            if addr is not None:
+                self._peers.get(addr).oneway("delete_object",
+                                             {"object_id": key})
         with self._lock:
             contained = self._contained.pop(object_id, [])
         me = self.worker.worker_id.binary()

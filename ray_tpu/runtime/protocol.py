@@ -16,6 +16,7 @@ are testable without real network faults.
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
@@ -76,6 +77,11 @@ _chaos = _ChaosState()
 def reset_chaos() -> None:
     global _chaos
     _chaos = _ChaosState()
+
+
+def _chaos_should_fail(method: str) -> bool:
+    """Current-table chaos check (shared with the native transport)."""
+    return _chaos.should_fail(method)
 
 
 # ---------------------------------------------------------------------------
@@ -234,7 +240,9 @@ class RpcServer:
                         HandlerContext(conn, req_id).reply(
                             None, error=RpcError(f"bad request: {e!r}"))
                         continue
-                    if msg[0] in self.inline_methods:
+                    if msg[0] in self.inline_methods or msg[0] == "__batch__":
+                        # batches route per-item below; unpacking them here
+                        # keeps inline items in per-connection arrival order
                         self._dispatch_decoded(conn, req_id, msg)
                     else:
                         self._pool.submit(
@@ -265,6 +273,17 @@ class RpcServer:
 
     def _dispatch_decoded(self, conn: _ServerConn, req_id: int, msg,
                           ctx: Optional[HandlerContext] = None) -> None:
+        if msg[0] == "__batch__":
+            # batched frame: [(req_id, method, body), ...] — dispatch each
+            # as an individual request; replies flow per inner id. Items
+            # honor inline_methods individually.
+            for rid, m, body in msg[1]:
+                if m in self.inline_methods:
+                    self._dispatch_decoded(conn, rid, (m, body))
+                else:
+                    self._pool.submit(self._dispatch_decoded, conn, rid,
+                                      (m, body))
+            return
         if ctx is None:
             ctx = HandlerContext(conn, req_id)
         try:
@@ -335,24 +354,38 @@ class RpcClient:
                              daemon=True, name=f"{self._name}-rd").start()
             return sock
 
+    @staticmethod
+    def _complete(entry, value, error: Optional[BaseException]) -> None:
+        """Resolve a pending entry: a Future or a callback(value, error)."""
+        if isinstance(entry, Future):
+            if entry.done():
+                return
+            if error is not None:
+                entry.set_exception(error)
+            else:
+                entry.set_result(value)
+        else:
+            try:
+                entry(value, error)
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+
     def _reader_loop(self, sock: socket.socket) -> None:
         try:
             while True:
                 req_id, payload = _recv_frame(sock)
                 req_id &= ~_REPLY_BIT
                 with self._pending_lock:
-                    fut = self._pending.pop(req_id, None)
-                if fut is None:
+                    entry = self._pending.pop(req_id, None)
+                if entry is None:
                     continue
                 try:
                     value, error = pickle.loads(payload)
                 except BaseException as e:  # noqa: BLE001
-                    fut.set_exception(RpcError(f"bad reply: {e!r}"))
+                    self._complete(entry, None, RpcError(f"bad reply: {e!r}"))
                     continue
-                if error is not None:
-                    fut.set_exception(error)
-                else:
-                    fut.set_result(value)
+                self._complete(entry, value, error)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -364,9 +397,8 @@ class RpcClient:
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
-        for fut in pending:
-            if not fut.done():
-                fut.set_exception(exc)
+        for entry in pending:
+            self._complete(entry, None, exc)
 
     # -- calls --
 
@@ -395,6 +427,42 @@ class RpcClient:
                 fut.set_exception(
                     e if isinstance(e, RpcError) else RpcError(repr(e)))
         return fut
+
+    def call_batch_cb(self, method: str, payloads: list,
+                      callback) -> list:
+        """Send many requests of one method in a single frame.
+
+        callback(index, value, error) fires once per request on the reader
+        thread (must not block). Returns the request ids. Same contract as
+        the native transport's call_batch_cb.
+        """
+        cfg = config_mod.GlobalConfig
+        if cfg.testing_rpc_delay_ms:
+            time.sleep(cfg.testing_rpc_delay_ms / 1000.0)
+        items = []
+        ids = []
+        with self._pending_lock:
+            for i, p in enumerate(payloads):
+                with self._id_lock:
+                    self._next_id += 1
+                    req_id = self._next_id
+                ids.append(req_id)
+                self._pending[req_id] = (lambda v, e, i=i: callback(i, v, e))
+                items.append((req_id, method, p))
+        try:
+            if _chaos.should_fail(method):
+                raise ChaosInjectedError(f"chaos: {method}")
+            sock = self._connect()
+            data = pickle.dumps(("__batch__", items), protocol=5)
+            _send_frame(sock, 0, data, self._wlock)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, RpcError) else RpcError(repr(e))
+            with self._pending_lock:
+                entries = [self._pending.pop(rid, None) for rid in ids]
+            for entry in entries:
+                if entry is not None:
+                    self._complete(entry, None, err)
+        return ids
 
     def call(self, method: str, payload: Any = None,
              timeout: Optional[float] = None) -> Any:
@@ -451,6 +519,13 @@ class RpcClient:
         with self._conn_lock:
             sock, self._sock = self._sock, None
         if sock is not None:
+            # shutdown BEFORE close: close() alone doesn't wake our reader
+            # thread blocked in recv, and the kernel socket (and its FIN to
+            # the peer) is held open until that recv returns
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 sock.close()
             except OSError:
@@ -463,13 +538,15 @@ class ClientPool:
 
     def __init__(self, name: str = "pool"):
         self._name = name
-        self._clients: Dict[str, RpcClient] = {}
+        self._clients: Dict[str, "RpcClient"] = {}
         self._lock = threading.Lock()
 
-    def get(self, address: str) -> RpcClient:
+    def get(self, address: str) -> "RpcClient":
         with self._lock:
             c = self._clients.get(address)
             if c is None:
+                # late global lookup: resolves to the transport selected at
+                # module bottom (native by default, pure-Python fallback)
                 c = RpcClient(address, name=self._name)
                 self._clients[address] = c
             return c
@@ -486,3 +563,28 @@ class ClientPool:
             self._clients.clear()
         for c in clients:
             c.close()
+
+
+# ---------------------------------------------------------------------------
+# transport selection
+#
+# The pure-Python classes above are the reference implementation and the
+# fallback; by default both RPC roles are served by the native C++ epoll
+# transport (src/transport.cc via protocol_native.py — the SURVEY §2.2
+# "native transport" requirement). Both speak the identical wire format, so
+# mixed clusters work. Set RTPU_NATIVE_TRANSPORT=0 to force pure Python
+# (used by the bench A/B and as an escape hatch).
+
+PyRpcServer = RpcServer
+PyRpcClient = RpcClient
+
+NATIVE_TRANSPORT = False
+_native_import_error: Optional[BaseException] = None
+if os.environ.get("RTPU_NATIVE_TRANSPORT", "1") != "0":
+    try:
+        from ray_tpu.runtime import protocol_native as _protocol_native
+        RpcServer = _protocol_native.RpcServer  # type: ignore[misc]
+        RpcClient = _protocol_native.RpcClient  # type: ignore[misc]
+        NATIVE_TRANSPORT = True
+    except Exception as _e:  # noqa: BLE001 — keep the Python fallback
+        _native_import_error = _e

@@ -58,6 +58,38 @@ def test_parallel_tasks(cluster_rt):
     assert dt < 1.3, f"tasks did not run in parallel: {dt:.2f}s"
 
 
+def test_parallel_burst_without_cached_leases(cluster_rt):
+    """A burst submitted while NO lease is cached must still fan out.
+
+    Regression: transport-level task batching once packed a whole queued
+    burst onto the FIRST granted lease, serializing onto one worker what
+    belonged on four (a lease is a concurrency slot — the bug survived
+    test_parallel_tasks because a warm cached lease changes the timing).
+    """
+    @rt.remote
+    def slp(i):
+        time.sleep(0.5)
+        return i
+
+    @rt.remote
+    def noop(i):
+        return i
+
+    # warm the worker POOL to 4 processes (spawn costs seconds on a 1-CPU
+    # host and is not what this test measures)...
+    rt.get([slp.options(name="warm").remote(i) for i in range(4)],
+           timeout=60)
+    # ...then let the cached idle leases reap (lease_idle_linger_s=0.5):
+    # the workers stay pooled but every task in the next burst depends on
+    # a fresh lease grant
+    time.sleep(1.2)
+    t0 = time.monotonic()
+    out = rt.get([slp.remote(i) for i in range(4)], timeout=60)
+    dt = time.monotonic() - t0
+    assert out == [0, 1, 2, 3]
+    assert dt < 1.8, f"burst did not run in parallel: {dt:.2f}s"
+
+
 def test_large_object_via_shm(cluster_rt):
     arr = np.arange(500_000, dtype=np.float64)
     ref = rt.put(arr)
