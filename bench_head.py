@@ -87,7 +87,23 @@ def main() -> None:
     rate = timed(kv_cycle, T)
     out.append({"metric": "head_kv_write_read_cycles_per_s",
                 "value": round(rate, 1),
-                "note": "256B values; one cycle = put + get"})
+                "note": "256B values; one cycle = put + get (pickle RPC "
+                        "path through the Python handlers)"})
+
+    # --- KV via the native fast path (served inside the head's C event
+    # loop; no Python, no pickle on the head — how ClusterBackend clients
+    # actually talk to a native head)
+    if hasattr(clients[0], "call_fast"):
+        from ray_tpu.runtime import protocol_native as pn
+
+        def kv_fast_cycle(t, i):
+            key = f"f:{t}:{i % 64}".encode()
+            clients[t].call_fast(pn.FAST_PUT, key, b"x" * 256, flags=1)
+            clients[t].call_fast(pn.FAST_GET, key)
+        rate = timed(kv_fast_cycle, T)
+        out.append({"metric": "head_kv_fast_write_read_cycles_per_s",
+                    "value": round(rate, 1),
+                    "note": "same cycle through the C-loop fast path"})
 
     # --- node registration: M nodes backed by a handful of live fake
     # servers (addresses must answer the health loop + lease RPCs)

@@ -70,6 +70,30 @@ constexpr int RT_IOV_BATCH = 64;
 
 enum EvType : uint8_t { EV_MSG = 1, EV_ACCEPT = 2, EV_DISCONNECT = 3 };
 
+// Fast-path frames: request id carries RT_FAST_BIT and the payload is the
+// binary KV protocol below — handled entirely inside the loop (no Python,
+// no pickle, no GIL). This is the head's native kv/ping service (role of
+// the reference's GcsInternalKVManager, src/ray/gcs/gcs_server/
+// gcs_kv_manager.h — a C++ KV the Python layer also reads directly).
+//   request:  u8 op | u8 flags | u32 klen | u64 vlen | key | val
+//   reply:    u8 status | u64 vlen | val
+constexpr uint64_t RT_FAST_BIT = 1ull << 62;
+constexpr uint64_t RT_REPLY_BIT = 1ull << 63;
+
+enum FastOp : uint8_t {
+  FOP_PUT = 1,   // flags bit0 = overwrite; status = 1 if newly created
+  FOP_GET = 2,   // status = 1 hit (val follows), 0 miss
+  FOP_DEL = 3,   // status = 1 if the key existed
+  FOP_PING = 4,  // status = 1, val = u64 incarnation
+};
+
+struct FastKV {
+  std::mutex mu;
+  std::unordered_map<std::string, std::string> kv;
+  uint64_t incarnation = 0;
+  std::atomic<uint64_t> version{0};  // bumped on mutation (persist-dirty)
+};
+
 struct rt_event {
   uint8_t type;
   uint64_t conn_id;
@@ -89,6 +113,7 @@ struct Conn {
   int fd = -1;
   bool connecting = false;  // nonblocking connect in flight
   std::atomic<bool> closed{false};
+  std::shared_ptr<FastKV> fastkv;  // set at accept if the listener has one
 
   // ---- write side + epoll mask (guarded by mu) ----
   std::mutex mu;
@@ -98,6 +123,7 @@ struct Conn {
   bool registered = false;   // fd added to epoll
   bool read_paused = false;  // poller-side inbound flow control
   uint32_t cur_mask = 0;
+  uint64_t last_send_ns = 0;  // burst detection for write coalescing
 
   // ---- read state (poller only) ----
   char hdr[16];
@@ -114,6 +140,7 @@ struct Listener {
   uint64_t id = 0;
   int fd = -1;
   int port = 0;
+  std::shared_ptr<FastKV> fastkv;  // non-null once rt_fastpath_enable ran
 };
 
 struct Op {
@@ -244,6 +271,120 @@ bool flush_writes(Loop* L, Conn* c) {
   return true;
 }
 
+// queue one frame on a conn and kick the write path (poller or any thread;
+// no backpressure wait — used for fast-path replies). Burst-coalescing
+// applies as in rt_send.
+void enqueue_frame(Loop* L, Conn* c, uint64_t req_id, const char* data,
+                   uint64_t len) {
+  char* buf = static_cast<char*>(malloc(16 + len));
+  memcpy(buf, &req_id, 8);
+  memcpy(buf + 8, &len, 8);
+  if (len) memcpy(buf + 16, data, len);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->closed.load()) {
+    free(buf);
+    return;
+  }
+  bool was_empty = c->wq.empty();
+  c->wq.push_back(Buf{buf, 16 + static_cast<size_t>(len), 0});
+  c->wq_bytes += 16 + len;
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  uint64_t now_ns =
+      static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+  bool bursting = now_ns - c->last_send_ns < 200000;
+  c->last_send_ns = now_ns;
+  if (was_empty && !bursting && !c->connecting && c->fd >= 0) {
+    iovec iov{buf, 16 + static_cast<size_t>(len)};
+    ssize_t w = writev(c->fd, &iov, 1);
+    if (w > 0) {
+      size_t sw = static_cast<size_t>(w);
+      c->wq_bytes -= sw;
+      if (sw == iov.iov_len) {
+        free(buf);
+        c->wq.pop_front();
+      } else {
+        c->wq.front().off = sw;
+      }
+    }
+  }
+  sync_mask(L, c);
+}
+
+// serve one fast-path KV frame inline on the poller; consumes (frees) body
+void handle_fast(Loop* L, Conn* c, uint64_t req_id, char* body,
+                 uint64_t blen) {
+  uint8_t status = 0;
+  std::string out;
+  if (blen >= 14) {
+    uint8_t op = static_cast<uint8_t>(body[0]);
+    uint8_t flags = static_cast<uint8_t>(body[1]);
+    uint32_t klen;
+    uint64_t vlen;
+    memcpy(&klen, body + 2, 4);
+    memcpy(&vlen, body + 6, 8);
+    if (14 + static_cast<uint64_t>(klen) + vlen <= blen) {
+      const char* key = body + 14;
+      const char* val = body + 14 + klen;
+      FastKV* kv = c->fastkv.get();
+      std::lock_guard<std::mutex> g(kv->mu);
+      switch (op) {
+        case FOP_PUT: {
+          auto it = kv->kv.find(std::string(key, klen));
+          bool exists = it != kv->kv.end();
+          if ((flags & 1) || !exists) {
+            kv->kv[std::string(key, klen)] = std::string(val, vlen);
+            kv->version.fetch_add(1);
+          }
+          status = exists ? 0 : 1;
+          break;
+        }
+        case FOP_GET: {
+          auto it = kv->kv.find(std::string(key, klen));
+          if (it != kv->kv.end()) {
+            status = 1;
+            out = it->second;
+          }
+          break;
+        }
+        case FOP_DEL: {
+          status = kv->kv.erase(std::string(key, klen)) ? 1 : 0;
+          if (status) kv->version.fetch_add(1);
+          break;
+        }
+        case FOP_PING: {
+          status = 1;
+          out.assign(reinterpret_cast<const char*>(&kv->incarnation), 8);
+          break;
+        }
+        default:
+          break;
+      }
+    }
+  }
+  free(body);
+  std::string reply;
+  reply.resize(9 + out.size());
+  reply[0] = static_cast<char>(status);
+  uint64_t vlen = out.size();
+  memcpy(&reply[1], &vlen, 8);
+  if (!out.empty()) memcpy(&reply[9], out.data(), out.size());
+  enqueue_frame(L, c, req_id | RT_REPLY_BIT, reply.data(), reply.size());
+}
+
+// route one completed inbound frame: fast-path KV inline, else event queue
+void deliver_frame(Loop* L, Conn* c) {
+  if ((c->cur_req & RT_FAST_BIT) && c->fastkv &&
+      !(c->cur_req & RT_REPLY_BIT)) {
+    handle_fast(L, c, c->cur_req, c->body, c->body_len);
+  } else {
+    L->q.push_back(Event{EV_MSG, c->id, c->cur_req, c->body, c->body_len});
+    L->q_bytes += c->body_len;
+  }
+  c->body = nullptr;
+  c->hdr_got = 0;
+}
+
 // read everything available; append MSG events. Returns false when the
 // conn died (peer closed or protocol violation).
 bool drain_reads(Loop* L, Conn* c) {
@@ -290,19 +431,12 @@ bool drain_reads(Loop* L, Conn* c) {
         c->body_got += take;
         off += take;
         if (c->body_got == c->body_len) {
-          L->q.push_back(Event{EV_MSG, c->id, c->cur_req, c->body,
-                               c->body_len});
-          L->q_bytes += c->body_len;
-          c->body = nullptr;
-          c->hdr_got = 0;
+          deliver_frame(L, c);
         }
       }
     }
     if (c->hdr_got == 16 && c->body != nullptr && c->body_got == c->body_len) {
-      L->q.push_back(Event{EV_MSG, c->id, c->cur_req, c->body, c->body_len});
-      L->q_bytes += c->body_len;
-      c->body = nullptr;
-      c->hdr_got = 0;
+      deliver_frame(L, c);
     }
     if (L->q_bytes > RT_INQ_HIGH_BYTES) {
       // inbound pressure: stop reading this conn; resumed once the caller
@@ -326,6 +460,7 @@ void handle_accept(Loop* L, Listener* lst) {
     set_nodelay(fd);
     auto c = std::make_shared<Conn>();
     c->fd = fd;
+    c->fastkv = lst->fastkv;
     {
       std::lock_guard<std::mutex> g(L->mu);
       c->id = L->next_id++;
@@ -529,6 +664,135 @@ int rt_listen_port(void* loop, uint64_t listener_id) {
   return it == L->listeners.end() ? -1 : it->second->port;
 }
 
+// ---- fast-path KV (native head kv/ping service + direct host access) ----
+
+static std::shared_ptr<FastKV> find_fastkv(Loop* L, uint64_t listener_id) {
+  std::lock_guard<std::mutex> g(L->mu);
+  auto it = L->listeners.find(listener_id);
+  return it == L->listeners.end() ? nullptr : it->second->fastkv;
+}
+
+int rt_fastpath_enable(void* loop, uint64_t listener_id,
+                       uint64_t incarnation) {
+  auto* L = static_cast<Loop*>(loop);
+  std::lock_guard<std::mutex> g(L->mu);
+  auto it = L->listeners.find(listener_id);
+  if (it == L->listeners.end()) return -1;
+  if (!it->second->fastkv) it->second->fastkv = std::make_shared<FastKV>();
+  it->second->fastkv->incarnation = incarnation;
+  return 0;
+  // NOTE: conns accepted BEFORE enable keep a null fastkv and route fast
+  // frames to Python (no handler -> error reply); enable before serving.
+}
+
+// returns 1 if newly created, 0 if key existed (value replaced only when
+// overwrite), -1 if no fastpath
+int rt_fastpath_put(void* loop, uint64_t listener_id, const char* key,
+                    uint32_t klen, const char* val, uint64_t vlen,
+                    int overwrite) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  auto it = kv->kv.find(std::string(key, klen));
+  bool exists = it != kv->kv.end();
+  if (overwrite || !exists) {
+    kv->kv[std::string(key, klen)] = std::string(val, vlen);
+    kv->version.fetch_add(1);
+  }
+  return exists ? 0 : 1;
+}
+
+// returns 1 hit (out/out_len set, free with rt_buf_free), 0 miss, -1 no fp
+int rt_fastpath_get(void* loop, uint64_t listener_id, const char* key,
+                    uint32_t klen, char** out, uint64_t* out_len) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  auto it = kv->kv.find(std::string(key, klen));
+  if (it == kv->kv.end()) return 0;
+  *out = dup_bytes(it->second.data(), it->second.size());
+  *out_len = it->second.size();
+  return 1;
+}
+
+int rt_fastpath_del(void* loop, uint64_t listener_id, const char* key,
+                    uint32_t klen) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  bool hit = kv->kv.erase(std::string(key, klen)) > 0;
+  if (hit) kv->version.fetch_add(1);
+  return hit ? 1 : 0;
+}
+
+uint64_t rt_fastpath_version(void* loop, uint64_t listener_id) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  return kv ? kv->version.load() : 0;
+}
+
+// dump the whole table: (u32 klen, key, u64 vlen, val)*; free via
+// rt_buf_free. Returns entry count, -1 if no fastpath.
+int64_t rt_fastpath_dump(void* loop, uint64_t listener_id, char** out,
+                         uint64_t* out_len) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  size_t total = 0;
+  for (auto& e : kv->kv) total += 12 + e.first.size() + e.second.size();
+  char* buf = static_cast<char*>(malloc(total ? total : 1));
+  char* p = buf;
+  for (auto& e : kv->kv) {
+    uint32_t kl = e.first.size();
+    uint64_t vl = e.second.size();
+    memcpy(p, &kl, 4);
+    p += 4;
+    memcpy(p, e.first.data(), kl);
+    p += kl;
+    memcpy(p, &vl, 8);
+    p += 8;
+    memcpy(p, e.second.data(), vl);
+    p += vl;
+  }
+  *out = buf;
+  *out_len = total;
+  return static_cast<int64_t>(kv->kv.size());
+}
+
+// keys-only dump with C-side prefix filter: (u32 klen, key)*; free via
+// rt_buf_free. Values never cross the boundary (they can be megabytes).
+// Returns matching-key count, -1 if no fastpath.
+int64_t rt_fastpath_keys(void* loop, uint64_t listener_id,
+                         const char* prefix, uint32_t plen, char** out,
+                         uint64_t* out_len) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  size_t total = 0;
+  int64_t n = 0;
+  for (auto& e : kv->kv) {
+    if (e.first.size() >= plen && memcmp(e.first.data(), prefix, plen) == 0) {
+      total += 4 + e.first.size();
+      n++;
+    }
+  }
+  char* buf = static_cast<char*>(malloc(total ? total : 1));
+  char* p = buf;
+  for (auto& e : kv->kv) {
+    if (e.first.size() >= plen && memcmp(e.first.data(), prefix, plen) == 0) {
+      uint32_t kl = e.first.size();
+      memcpy(p, &kl, 4);
+      p += 4;
+      memcpy(p, e.first.data(), kl);
+      p += kl;
+    }
+  }
+  *out = buf;
+  *out_len = total;
+  return n;
+}
+
+void rt_buf_free(char* p) { free(p); }
+
 // resolve + start a nonblocking connect; the poller completes it.
 // Returns conn id (>0), or 0 if the address didn't resolve.
 uint64_t rt_connect(void* loop, const char* host, int port) {
@@ -616,7 +880,19 @@ int rt_send(void* loop, uint64_t conn_id, uint64_t req_id, const char* data,
   bool was_empty = c->wq.empty();
   c->wq.push_back(Buf{buf, 16 + static_cast<size_t>(len), 0});
   c->wq_bytes += 16 + len;
-  if (was_empty && !c->connecting && c->fd >= 0) {
+  // Burst detection: every small writev to a watched socket wakes the
+  // receiver process — on a busy single-CPU host that's a ~100µs scheduler
+  // round-trip PER FRAME. If another send hit this conn within the last
+  // 200µs we are in a burst: leave the frame queued so the poller flushes
+  // many frames in ONE writev (receiver wakes once per batch). Isolated
+  // sends keep the inline write for latency.
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  uint64_t now_ns =
+      static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+  bool bursting = now_ns - c->last_send_ns < 200000;
+  c->last_send_ns = now_ns;
+  if (was_empty && !bursting && !c->connecting && c->fd >= 0) {
     // latency fast-path: try the write inline; leftovers flushed on
     // EPOLLOUT by the poller
     iovec iov{buf, 16 + static_cast<size_t>(len)};

@@ -155,13 +155,20 @@ class _TaskSubmitter:
                         spawn = max(0, want - self.requesting)
                         self.requesting += spawn
                     break
-                # Exactly ONE task per lease at a time: a lease is a
-                # concurrency slot, and packing queued tasks onto it would
-                # serialize work that belongs on other workers (verified
-                # regression: 4 sleeping tasks ran serially on one worker).
-                # Actor submitters batch instead — actor calls are serial
-                # by contract. Transport-level coalescing still applies.
-                tasks = [self.pending.popleft()]
+                # Parallelism-neutral batching: pack at most an equal
+                # share of the queue onto this lease (pending divided by
+                # every lease that exists or is being requested). A lease
+                # is a concurrency slot — packing a small burst onto the
+                # FIRST grant serialized work that belonged on other
+                # workers (verified regression: 4 sleeping tasks on one
+                # worker). With the share rule a burst of B <= slots tasks
+                # batches as 1 per lease, while a 1000-task burst ships in
+                # 32-task frames that amortize the per-frame scheduler
+                # round-trip without changing who-runs-what.
+                slots = max(1, len(self.leases) + self.requesting)
+                share = -(-len(self.pending) // slots)  # ceil div
+                n = min(share, config_mod.GlobalConfig.task_push_batch)
+                tasks = [self.pending.popleft() for _ in range(n)]
                 lease.busy = True
             self._push_batch(lease, tasks)
         for _ in range(spawn):
@@ -570,6 +577,19 @@ class ClusterBackend:
 
         worker.worker_id = worker_id or WorkerID.from_random()
 
+        # Native-KV probe: with the C++ transport on both ends, kv/ping
+        # traffic is served inside the head's event loop (fast frames —
+        # protocol_native.call_fast). One ping detects it; a pure-Python
+        # peer answers with an error and we stay on the pickle path.
+        self._head_fast = False
+        if hasattr(self.head, "call_fast"):
+            try:
+                from ray_tpu.runtime import protocol_native as _pn
+                status, _ = self.head.call_fast(_pn.FAST_PING, timeout=5.0)
+                self._head_fast = status == 1
+            except Exception:  # noqa: BLE001 — fall back to pickle path
+                self._head_fast = False
+
         # node registry + local shm store
         nodes = self.head.call_retrying("list_nodes")
         node_addrs = {n["node_id"]: n["address"] for n in nodes}
@@ -605,9 +625,8 @@ class ClusterBackend:
             "borrow_batch": self._h_borrow_batch,
             "ping": lambda p, c: "pong",
         }, name=f"{role}-owner")
-        self.head.call_retrying("kv_put", {
-            "key": f"addr:{worker.worker_id.hex()}",
-            "value": self.server.address})
+        self.kv_put(f"addr:{worker.worker_id.hex()}",
+                    self.server.address)
 
         # borrowed-ref owner map for unborrow notifications
         self._borrowed_owner: Dict[ObjectID, WorkerID] = {}
@@ -673,6 +692,60 @@ class ClusterBackend:
                     "objects": objects})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
+
+    # ------------------------------------------------------------ head KV
+
+    def kv_put(self, key: str, value: Any, overwrite: bool = True) -> bool:
+        """Head KV write — native fast frame when both ends are C++
+        transport (no Python runs on the head), pickle RPC otherwise."""
+        if self._head_fast:
+            import pickle
+            from ray_tpu.runtime import protocol_native as _pn
+            try:
+                status, _ = self._fast_retry(
+                    _pn.FAST_PUT, key.encode(),
+                    pickle.dumps(value, protocol=5),
+                    flags=1 if overwrite else 0)
+                return status == 1
+            except RpcError:
+                pass  # head unreachable via fast path: use retrying RPC
+        return bool(self.head.call_retrying("kv_put", {
+            "key": key, "value": value, "overwrite": overwrite}))
+
+    def kv_get(self, key: str) -> Any:
+        if self._head_fast:
+            import pickle
+            from ray_tpu.runtime import protocol_native as _pn
+            try:
+                status, raw = self._fast_retry(_pn.FAST_GET, key.encode())
+                return pickle.loads(raw) if status == 1 else None
+            except RpcError:
+                pass
+        return self.head.call_retrying("kv_get", {"key": key})
+
+    def kv_del(self, key: str) -> bool:
+        if self._head_fast:
+            from ray_tpu.runtime import protocol_native as _pn
+            try:
+                status, _ = self._fast_retry(_pn.FAST_DEL, key.encode())
+                return status == 1
+            except RpcError:
+                pass
+        return bool(self.head.call("kv_del", {"key": key}, timeout=5.0))
+
+    def _fast_retry(self, op: int, key: bytes, val: bytes = b"",
+                    flags: int = 0) -> tuple:
+        cfg = config_mod.GlobalConfig
+        delay = cfg.rpc_retry_base_ms / 1000.0
+        last: Optional[Exception] = None
+        for i in range(max(1, cfg.rpc_retry_max_attempts)):
+            try:
+                return self.head.call_fast(op, key, val, flags=flags)
+            except RpcError as e:
+                last = e
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+        raise last  # type: ignore[misc]
 
     # ------------------------------------------------------------- factories
 
@@ -844,8 +917,7 @@ class ClusterBackend:
         if cached is not None and cached[0] == self._export_epoch:
             return cached[1]
         key, blob = wire.export_function(fn)
-        self.head.call_retrying("kv_put", {
-            "key": key, "value": blob, "overwrite": False})
+        self.kv_put(key, blob, overwrite=False)
         try:
             fn.__rtpu_export_key__ = (self._export_epoch, key)
         except (AttributeError, TypeError):
@@ -868,9 +940,7 @@ class ClusterBackend:
                 uri = self._rtenv_uploads.get(wd)
             if uri is None:
                 uri, blob = rtenv.package_working_dir(wd)
-                self.head.call_retrying("kv_put", {
-                    "key": rtenv.kv_key(uri), "value": blob,
-                    "overwrite": False})
+                self.kv_put(rtenv.kv_key(uri), blob, overwrite=False)
                 with self._lock:
                     self._rtenv_uploads[wd] = uri
             out["working_dir_uri"] = uri
@@ -1163,9 +1233,7 @@ class ClusterBackend:
         for sub in subs:
             sub.shutdown()
         try:
-            self.head.call("kv_del",
-                           {"key": f"addr:{self.worker.worker_id.hex()}"},
-                           timeout=2.0)
+            self.kv_del(f"addr:{self.worker.worker_id.hex()}")
         except RpcError:
             pass
         self.server.stop()

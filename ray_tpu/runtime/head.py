@@ -61,6 +61,90 @@ def _bundle_draw(pg: dict, idx: int, resources: Dict[str, float]) -> None:
         used[k] = used.get(k, 0.0) + v
 
 
+class _KvStore:
+    """Head internal KV table (reference: GcsInternalKVManager,
+    src/ray/gcs/gcs_server/gcs_kv_manager.h — a C++ KV service the Python
+    layer also reads).
+
+    Plain dict until the RPC server exists; once the native transport's
+    listener fast-path is enabled the table LIVES inside the C event loop
+    (src/transport.cc FastKV): client kv/ping frames are answered without
+    entering Python at all, and this adapter becomes the head-side
+    accessor over the same map. Values are pickled so str/bytes/objects
+    round-trip identically through both paths.
+    """
+
+    def __init__(self):
+        self._dict: Optional[Dict[str, Any]] = {}
+        self._server = None
+        self._mutations = 0  # dict-mode mutation counter (dirty tracking)
+        # dict-mode check-and-set atomicity (handlers run on a thread
+        # pool); the native store has its own C-side mutex
+        self._dict_lock = threading.Lock()
+
+    def attach_native(self, server, incarnation: int) -> bool:
+        if not hasattr(server, "enable_kv_fastpath"):
+            return False  # pure-Python transport fallback
+        if not server.enable_kv_fastpath(incarnation):
+            return False
+        for k, v in self._dict.items():  # migrate snapshot-restored keys
+            server.kv_fast_put(k.encode(), pickle.dumps(v, protocol=5))
+        self._server = server
+        self._dict = None
+        return True
+
+    @property
+    def native(self) -> bool:
+        return self._server is not None
+
+    def put(self, key: str, value: Any, overwrite: bool = True) -> bool:
+        """Returns True when the key was newly created."""
+        if self._server is not None:
+            return self._server.kv_fast_put(
+                key.encode(), pickle.dumps(value, protocol=5), overwrite)
+        with self._dict_lock:
+            exists = key in self._dict
+            if overwrite or not exists:
+                self._dict[key] = value
+                self._mutations += 1
+            return not exists
+
+    def get(self, key: str) -> Any:
+        if self._server is not None:
+            raw = self._server.kv_fast_get(key.encode())
+            return None if raw is None else pickle.loads(raw)
+        return self._dict.get(key)
+
+    def delete(self, key: str) -> bool:
+        if self._server is not None:
+            return self._server.kv_fast_del(key.encode())
+        with self._dict_lock:
+            if key in self._dict:
+                del self._dict[key]
+                self._mutations += 1
+                return True
+            return False
+
+    def keys(self, prefix: str = "") -> List[str]:
+        if self._server is not None:
+            return [k.decode()
+                    for k in self._server.kv_fast_keys(prefix.encode())]
+        return [k for k in self._dict if k.startswith(prefix)]
+
+    def items(self) -> Dict[str, Any]:
+        if self._server is not None:
+            return {k.decode(): pickle.loads(v)
+                    for k, v in self._server.kv_fast_items().items()}
+        return dict(self._dict)
+
+    def version(self) -> int:
+        """Mutation counter — client fast-path writes bypass Python, so
+        persistence polls this instead of relying on handler dirty bits."""
+        if self._server is not None:
+            return self._server.kv_fast_version()
+        return self._mutations
+
+
 class _NodeEntry:
     __slots__ = ("node_id", "address", "shm_name", "resources", "alive",
                  "last_seen", "missed")
@@ -168,7 +252,7 @@ class Head:
         self._actors: Dict[bytes, _ActorEntry] = {}
         self._named: Dict[str, bytes] = {}  # "ns:name" -> actor_id
         self._actor_by_worker: Dict[bytes, bytes] = {}  # worker_id -> actor_id
-        self._kv: Dict[str, bytes] = {}
+        self._kv = _KvStore()
         self._pgs: Dict[bytes, dict] = {}  # PlacementGroupID bin -> info
         self._next_job = 0
         if self._persist_path:
@@ -231,6 +315,12 @@ class Head:
             "ping": lambda p, c: {"pong": True,
                                   "incarnation": self.incarnation},
         }, host=host, port=port, max_workers=32, name="head")
+        # Native kv/ping service: with the C++ transport, kv_put/kv_get/
+        # kv_del/ping fast-frames are answered inside the event loop — the
+        # head's Python never runs for them (SURVEY §2.2 native control
+        # plane; the Python handlers above remain for pickle-path clients
+        # and both views share one table).
+        self._kv.attach_native(self.server, int(self.incarnation, 16))
         # a crashed client can't release its leases; reclaim them when its
         # connection drops (reference: raylet returns leased workers when
         # the owner dies — lease lifetime is bound to the owner)
@@ -273,7 +363,8 @@ class Head:
                   flush=True)
             return
         with self._lock:
-            self._kv.update(data.get("kv", {}))
+            for k, v in data.get("kv", {}).items():
+                self._kv.put(k, v)
             self._next_job = max(self._next_job, data.get("next_job", 0))
             for rec in data.get("actors", ()):
                 entry = _ActorEntry(rec["actor_id"], rec["spec_bytes"],
@@ -335,7 +426,7 @@ class Head:
                     pgs[pid] = {k: pg[k] for k in
                                 ("bundles", "nodes", "state", "strategy",
                                  "name")}
-                snap = {"kv": dict(self._kv), "next_job": self._next_job,
+                snap = {"kv": self._kv.items(), "next_job": self._next_job,
                         "actors": actors, "named": dict(self._named),
                         "pgs": pgs}
                 self._persist_dirty = False
@@ -354,11 +445,19 @@ class Head:
                 raise
 
     def _persist_loop(self) -> None:
+        last_kv_version = self._kv.version()
         while not self._stopped.is_set():
             self._persist_kick.wait(timeout=1.0)
             self._persist_kick.clear()
             if self._stopped.is_set():
                 return  # stop() takes the final snapshot itself
+            # native-fast-path KV writes never enter Python, so dirtiness
+            # is detected by polling the table's mutation counter
+            v = self._kv.version()
+            if v != last_kv_version:
+                last_kv_version = v
+                with self._lock:
+                    self._persist_dirty = True
             try:
                 self._save_snapshot()
             except Exception:  # noqa: BLE001
@@ -517,28 +616,23 @@ class Head:
     # --------------------------------------------------------------------- kv
 
     def _h_kv_put(self, p, ctx):
-        with self._lock:
-            exists = p["key"] in self._kv
-            if p.get("overwrite", True) or not exists:
-                self._kv[p["key"]] = p["value"]
-                self._persist_dirty = True
-        return not exists
+        # pickle-path clients (and the pure-Python transport); native
+        # clients hit the C fast path and never reach here. Both write
+        # the same table (_KvStore); persistence dirtiness is tracked by
+        # the kv version counter in _persist_loop, so no-op puts (the
+        # overwrite=False dedup path every worker hits re-exporting the
+        # same function blobs) don't force snapshot rewrites.
+        return self._kv.put(p["key"], p["value"], p.get("overwrite", True))
 
     def _h_kv_get(self, p, ctx):
-        with self._lock:
-            return self._kv.get(p["key"])
+        return self._kv.get(p["key"])
 
     def _h_kv_del(self, p, ctx):
-        with self._lock:
-            hit = self._kv.pop(p["key"], None) is not None
-            if hit:
-                self._persist_dirty = True
-            return hit
+        # dirtiness via the version counter, as in _h_kv_put
+        return self._kv.delete(p["key"])
 
     def _h_kv_keys(self, p, ctx):
-        prefix = p.get("prefix", "")
-        with self._lock:
-            return [k for k in self._kv if k.startswith(prefix)]
+        return self._kv.keys(p.get("prefix", ""))
 
     # ----------------------------------------------------------------- leases
 

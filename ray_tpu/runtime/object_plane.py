@@ -568,16 +568,36 @@ class ObjectPlane:
         with self._lock:
             secondaries = self.secondary.pop(object_id, set())
         secondaries.discard(node_id)
-        # Oneway + no node-map refresh: deletes are best-effort (errors
-        # were swallowed even as unary calls) and this path runs inside
-        # reply callbacks on the transport dispatcher thread, which must
-        # never block on an RPC (node_client's refresh path calls the
-        # head). A node missing from the map is gone — its copy with it.
-        for n in ([node_id] if node_id is not None else []) + list(secondaries):
+        # Oneway, and never a blocking call on THIS thread: this path runs
+        # inside reply callbacks on the transport dispatcher, and
+        # node_client's refresh path calls the head. Nodes already in the
+        # cached map get their delete directly; nodes that joined after
+        # our last refresh (autoscale) are handled by a background thread
+        # that refreshes the map first — skipping them would leak their
+        # pinned primary copies until the arena fills.
+        targets = ([node_id] if node_id is not None else []) \
+            + list(secondaries)
+        unknown = []
+        for n in targets:
             addr = self.node_addrs.get(n)
             if addr is not None:
                 self._peers.get(addr).oneway("delete_object",
                                              {"object_id": key})
+            else:
+                unknown.append(n)
+        if unknown:
+            def _late_delete():
+                try:
+                    self.refresh_nodes()
+                except Exception:  # noqa: BLE001 — head gone: give up
+                    return
+                for n in unknown:
+                    addr = self.node_addrs.get(n)
+                    if addr is not None:
+                        self._peers.get(addr).oneway(
+                            "delete_object", {"object_id": key})
+            threading.Thread(target=_late_delete, daemon=True,
+                             name="late-delete").start()
         with self._lock:
             contained = self._contained.pop(object_id, [])
         me = self.worker.worker_id.binary()

@@ -22,6 +22,7 @@ from __future__ import annotations
 import ctypes
 import os
 import pickle
+import struct
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -30,9 +31,15 @@ from typing import Any, Callable, Dict, Optional
 from ray_tpu.core import config as config_mod
 
 _REPLY_BIT = 1 << 63
+_FAST_BIT = 1 << 62  # binary KV fast-path frame, served inside the C loop
 
 _MSG, _ACCEPT, _DISCONNECT = 1, 2, 3
 _POLL_BATCH = 512
+
+# fast-path ops (mirror transport.cc FastOp)
+FAST_PUT, FAST_GET, FAST_DEL, FAST_PING = 1, 2, 3, 4
+_FAST_REQ = struct.Struct("<BBIQ")  # op, flags, klen, vlen
+_FAST_REP = struct.Struct("<BQ")    # status, vlen
 
 
 class _RtEvent(ctypes.Structure):
@@ -62,6 +69,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_poll.restype = ctypes.c_int
     lib.rt_poll.argtypes = [ctypes.c_void_p, ctypes.POINTER(_RtEvent),
                             ctypes.c_int, ctypes.c_int]
+    lib.rt_fastpath_enable.restype = ctypes.c_int
+    lib.rt_fastpath_enable.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_uint64]
+    lib.rt_fastpath_put.restype = ctypes.c_int
+    lib.rt_fastpath_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+    lib.rt_fastpath_get.restype = ctypes.c_int
+    lib.rt_fastpath_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastpath_del.restype = ctypes.c_int
+    lib.rt_fastpath_del.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                    ctypes.c_char_p, ctypes.c_uint32]
+    lib.rt_fastpath_version.restype = ctypes.c_uint64
+    lib.rt_fastpath_version.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rt_fastpath_dump.restype = ctypes.c_int64
+    lib.rt_fastpath_dump.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastpath_keys.restype = ctypes.c_int64
+    lib.rt_fastpath_keys.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_buf_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -328,6 +360,97 @@ class RpcServer:
         except BaseException as e:  # noqa: BLE001
             ctx.reply(None, error=e)
 
+    # -- native KV fast-path (host-side access) --
+
+    def enable_kv_fastpath(self, incarnation: int = 0) -> bool:
+        """Serve FAST_* frames on this listener entirely inside the C
+        loop. The host process reads/writes the SAME table via the
+        kv_fast_* methods below (role of the reference's C++
+        GcsInternalKVManager with Python-side accessors)."""
+        t = self._transport
+        return t.lib.rt_fastpath_enable(t.loop, self._listener,
+                                        incarnation) == 0
+
+    def kv_fast_put(self, key: bytes, val: bytes,
+                    overwrite: bool = True) -> bool:
+        t = self._transport
+        rc = t.fastlib.rt_fastpath_put(t.loop, self._listener, key,
+                                       len(key), val, len(val),
+                                       1 if overwrite else 0)
+        return rc == 1  # newly created
+
+    def kv_fast_get(self, key: bytes) -> Optional[bytes]:
+        t = self._transport
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        rc = t.fastlib.rt_fastpath_get(t.loop, self._listener, key,
+                                       len(key), ctypes.byref(out),
+                                       ctypes.byref(out_len))
+        if rc != 1:
+            return None
+        try:
+            return ctypes.string_at(out.value, out_len.value)
+        finally:
+            t.fastlib.rt_buf_free(out)
+
+    def kv_fast_del(self, key: bytes) -> bool:
+        t = self._transport
+        return t.fastlib.rt_fastpath_del(t.loop, self._listener, key,
+                                         len(key)) == 1
+
+    def kv_fast_keys(self, prefix: bytes = b"") -> list:
+        """Keys matching prefix — filtered C-side so values (possibly
+        megabytes of export blobs) never cross the boundary."""
+        t = self._transport
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        n = t.fastlib.rt_fastpath_keys(t.loop, self._listener, prefix,
+                                       len(prefix), ctypes.byref(out),
+                                       ctypes.byref(out_len))
+        if n < 0:
+            return []
+        try:
+            buf = ctypes.string_at(out.value, out_len.value)
+        finally:
+            t.fastlib.rt_buf_free(out)
+        keys = []
+        off = 0
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            keys.append(buf[off:off + klen])
+            off += klen
+        return keys
+
+    def kv_fast_version(self) -> int:
+        t = self._transport
+        return t.fastlib.rt_fastpath_version(t.loop, self._listener)
+
+    def kv_fast_items(self) -> Dict[bytes, bytes]:
+        t = self._transport
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        n = t.lib.rt_fastpath_dump(t.loop, self._listener,
+                                   ctypes.byref(out), ctypes.byref(out_len))
+        if n < 0:
+            return {}
+        try:
+            buf = ctypes.string_at(out.value, out_len.value)
+        finally:
+            t.fastlib.rt_buf_free(out)
+        items: Dict[bytes, bytes] = {}
+        off = 0
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            key = buf[off:off + klen]
+            off += klen
+            (vlen,) = struct.unpack_from("<Q", buf, off)
+            off += 8
+            items[key] = buf[off:off + vlen]
+            off += vlen
+        return items
+
     def _on_conn_closed(self, conn: _ServerConn) -> None:
         conn.alive = False
         self._conns.pop(conn.conn_id, None)
@@ -427,9 +550,16 @@ class RpcClient:
     def _on_reply_frame(self, req_id: int, payload: bytes) -> None:
         from ray_tpu.runtime.protocol import RpcError
         req_id &= ~_REPLY_BIT
+        fast = bool(req_id & _FAST_BIT)
+        req_id &= ~_FAST_BIT
         with self._pending_lock:
             entry = self._pending.pop(req_id, None)
         if entry is None:
+            return
+        if fast:
+            # binary fast-path reply: (status, value bytes)
+            status, vlen = _FAST_REP.unpack_from(payload)
+            self._complete(entry, (status, payload[9:9 + vlen]), None)
             return
         try:
             value, error = pickle.loads(payload)
@@ -515,6 +645,36 @@ class RpcClient:
                 if entry is not None:
                     self._complete(entry, None, err)
         return ids
+
+    def call_fast(self, op: int, key: bytes = b"", val: bytes = b"",
+                  flags: int = 0,
+                  timeout: Optional[float] = None) -> tuple:
+        """Binary KV fast-path call, served inside the peer's C loop
+        (no Python on the server). Returns (status, value_bytes).
+        Only valid against a server with the fastpath enabled."""
+        from ray_tpu.runtime.protocol import RpcError
+        if timeout is None:
+            timeout = config_mod.GlobalConfig.rpc_call_timeout_s
+        fut: Future = Future()
+        req_id = self._alloc_id()
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        data = _FAST_REQ.pack(op, flags, len(key), len(val)) + key + val
+        try:
+            conn = self._connect()
+            if self._transport.send(conn, req_id | _FAST_BIT, data) != 0:
+                raise RpcError(f"connection to {self.address} lost")
+        except BaseException as e:  # noqa: BLE001
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise e if isinstance(e, RpcError) else RpcError(repr(e))
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise RpcError(f"fast call to {self.address} timed out "
+                           f"after {timeout}s") from None
 
     def call(self, method: str, payload: Any = None,
              timeout: Optional[float] = None) -> Any:

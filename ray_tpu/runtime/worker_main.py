@@ -232,7 +232,7 @@ class Executor:
     def _resolve_function(self, key: str):
         fn = self.fn_cache.get(key)
         if fn is None:
-            blob = self.backend.head.call_retrying("kv_get", {"key": key})
+            blob = self.backend.kv_get(key)
             if blob is None:
                 raise TaskError("LookupError", f"function {key} not exported",
                                 "<head kv miss>")

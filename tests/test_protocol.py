@@ -198,3 +198,60 @@ def test_cross_impl_wire_compat():
     assert nclient.call("echo", {"k": "v"}) == {"k": "v"}
     nclient.close()
     pserver.stop()
+
+
+def test_kv_fastpath_roundtrip():
+    """Fast frames are served inside the C loop; host accessors see the
+    same table (native head KV, transport.cc FastKV)."""
+    server = protocol_native.RpcServer({}, name="fkv")
+    assert server.enable_kv_fastpath(incarnation=42)
+    client = protocol_native.RpcClient(server.address, name="fkv-c")
+    try:
+        # ping carries the incarnation
+        status, val = client.call_fast(protocol_native.FAST_PING, timeout=10)
+        assert status == 1
+        import struct as _s
+        assert _s.unpack("<Q", val)[0] == 42
+        # put (created) / get / overwrite semantics / del
+        st, _ = client.call_fast(protocol_native.FAST_PUT, b"k1", b"v1",
+                                 flags=1, timeout=10)
+        assert st == 1  # created
+        st, v = client.call_fast(protocol_native.FAST_GET, b"k1", timeout=10)
+        assert (st, v) == (1, b"v1")
+        st, _ = client.call_fast(protocol_native.FAST_PUT, b"k1", b"v2",
+                                 flags=0, timeout=10)  # no-overwrite
+        assert st == 0  # existed, not replaced
+        st, v = client.call_fast(protocol_native.FAST_GET, b"k1", timeout=10)
+        assert v == b"v1"
+        # host-side view is the same table
+        assert server.kv_fast_get(b"k1") == b"v1"
+        server.kv_fast_put(b"k2", b"host")
+        st, v = client.call_fast(protocol_native.FAST_GET, b"k2", timeout=10)
+        assert (st, v) == (1, b"host")
+        assert set(server.kv_fast_items()) == {b"k1", b"k2"}
+        v0 = server.kv_fast_version()
+        st, _ = client.call_fast(protocol_native.FAST_DEL, b"k1", timeout=10)
+        assert st == 1
+        assert server.kv_fast_version() > v0
+        st, _ = client.call_fast(protocol_native.FAST_GET, b"k1", timeout=10)
+        assert st == 0
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_kv_fastpath_mixed_with_pickle_calls():
+    """Fast and regular frames interleave on one connection."""
+    server = protocol_native.RpcServer(_echo_handlers(), name="mix")
+    server.enable_kv_fastpath()
+    client = protocol_native.RpcClient(server.address, name="mix-c")
+    try:
+        for i in range(50):
+            client.call_fast(protocol_native.FAST_PUT, b"k%d" % i,
+                             b"v%d" % i, flags=1, timeout=10)
+            assert client.call("echo", i, timeout=10) == i
+        st, v = client.call_fast(protocol_native.FAST_GET, b"k7", timeout=10)
+        assert (st, v) == (1, b"v7")
+    finally:
+        client.close()
+        server.stop()
