@@ -121,7 +121,8 @@ struct Conn {
   std::deque<Buf> wq;
   size_t wq_bytes = 0;
   bool registered = false;   // fd added to epoll
-  bool read_paused = false;  // poller-side inbound flow control
+  bool read_paused = false;     // inbound event-queue flow control
+  bool read_paused_wq = false;  // outbound (reply) backlog flow control
   uint32_t cur_mask = 0;
   uint64_t last_send_ns = 0;  // burst detection for write coalescing
 
@@ -196,7 +197,7 @@ char* dup_bytes(const char* p, size_t n) {
 void sync_mask(Loop* L, Conn* c) {
   if (c->fd < 0 || !c->registered || c->closed.load()) return;
   uint32_t mask = 0;
-  if (!c->read_paused) mask |= EPOLLIN;
+  if (!c->read_paused && !c->read_paused_wq) mask |= EPOLLIN;
   if (c->connecting || !c->wq.empty()) mask |= EPOLLOUT;
   if (mask == c->cur_mask) return;
   epoll_event ev{};
@@ -267,6 +268,10 @@ bool flush_writes(Loop* L, Conn* c) {
     }
     if (c->wq_bytes < RT_WQ_LOW_BYTES) c->wcv.notify_all();
   }
+  // reply backlog drained: resume reading requests from this peer
+  if (c->read_paused_wq && c->wq_bytes < RT_WQ_LOW_BYTES) {
+    c->read_paused_wq = false;
+  }
   sync_mask(L, c);
   return true;
 }
@@ -323,7 +328,10 @@ void handle_fast(Loop* L, Conn* c, uint64_t req_id, char* body,
     uint64_t vlen;
     memcpy(&klen, body + 2, 4);
     memcpy(&vlen, body + 6, 8);
-    if (14 + static_cast<uint64_t>(klen) + vlen <= blen) {
+    // overflow-safe bounds: klen/vlen are attacker-controlled; summing
+    // them can wrap and a wrapped check would std::length_error (and
+    // terminate) on the string constructors below
+    if (klen <= blen - 14 && vlen <= blen - 14 - klen) {
       const char* key = body + 14;
       const char* val = body + 14 + klen;
       FastKV* kv = c->fastkv.get();
@@ -377,6 +385,15 @@ void deliver_frame(Loop* L, Conn* c) {
   if ((c->cur_req & RT_FAST_BIT) && c->fastkv &&
       !(c->cur_req & RT_REPLY_BIT)) {
     handle_fast(L, c, c->cur_req, c->body, c->body_len);
+    // fast replies bypass the event queue, so the inbound q_bytes pause
+    // never fires for them — bound the REPLY backlog instead: stop
+    // reading a peer that streams requests faster than it drains replies
+    // (resumed by flush_writes once wq falls below the low-water mark)
+    if (c->wq_bytes > RT_WQ_HIGH_BYTES) {
+      std::lock_guard<std::mutex> g(c->mu);
+      c->read_paused_wq = true;
+      sync_mask(L, c);
+    }
   } else {
     L->q.push_back(Event{EV_MSG, c->id, c->cur_req, c->body, c->body_len});
     L->q_bytes += c->body_len;

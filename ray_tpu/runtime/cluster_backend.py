@@ -736,15 +736,17 @@ class ClusterBackend:
     def _fast_retry(self, op: int, key: bytes, val: bytes = b"",
                     flags: int = 0) -> tuple:
         cfg = config_mod.GlobalConfig
+        attempts = max(1, cfg.rpc_retry_max_attempts)
         delay = cfg.rpc_retry_base_ms / 1000.0
         last: Optional[Exception] = None
-        for i in range(max(1, cfg.rpc_retry_max_attempts)):
+        for i in range(attempts):
             try:
                 return self.head.call_fast(op, key, val, flags=flags)
             except RpcError as e:
                 last = e
-                time.sleep(delay)
-                delay = min(delay * 2, 5.0)
+                if i + 1 < attempts:  # no pointless sleep before the raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 5.0)
         raise last  # type: ignore[misc]
 
     # ------------------------------------------------------------- factories

@@ -87,10 +87,16 @@ class _KvStore:
             return False  # pure-Python transport fallback
         if not server.enable_kv_fastpath(incarnation):
             return False
-        for k, v in self._dict.items():  # migrate snapshot-restored keys
-            server.kv_fast_put(k.encode(), pickle.dumps(v, protocol=5))
-        self._server = server
-        self._dict = None
+        # migrate under the dict lock: the RPC server is ALREADY serving,
+        # so a pickle-path kv_put can race this loop (reconnecting clients
+        # land the moment the listener is up). Writers that grab the lock
+        # after us see _server set and go native; writers that held it
+        # before us finished their dict write before we copied.
+        with self._dict_lock:
+            for k, v in self._dict.items():  # migrate snapshot-restored keys
+                server.kv_fast_put(k.encode(), pickle.dumps(v, protocol=5))
+            self._server = server
+            self._dict = None
         return True
 
     @property
@@ -99,43 +105,72 @@ class _KvStore:
 
     def put(self, key: str, value: Any, overwrite: bool = True) -> bool:
         """Returns True when the key was newly created."""
-        if self._server is not None:
-            return self._server.kv_fast_put(
-                key.encode(), pickle.dumps(value, protocol=5), overwrite)
-        with self._dict_lock:
-            exists = key in self._dict
-            if overwrite or not exists:
-                self._dict[key] = value
-                self._mutations += 1
-            return not exists
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:  # not migrated mid-wait
+                    exists = key in self._dict
+                    if overwrite or not exists:
+                        self._dict[key] = value
+                        self._mutations += 1
+                    return not exists
+        return self._server.kv_fast_put(
+            key.encode(), pickle.dumps(value, protocol=5), overwrite)
 
     def get(self, key: str) -> Any:
-        if self._server is not None:
-            raw = self._server.kv_fast_get(key.encode())
-            return None if raw is None else pickle.loads(raw)
-        return self._dict.get(key)
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:
+                    return self._dict.get(key)
+        raw = self._server.kv_fast_get(key.encode())
+        return None if raw is None else pickle.loads(raw)
 
     def delete(self, key: str) -> bool:
-        if self._server is not None:
-            return self._server.kv_fast_del(key.encode())
-        with self._dict_lock:
-            if key in self._dict:
-                del self._dict[key]
-                self._mutations += 1
-                return True
-            return False
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:
+                    if key in self._dict:
+                        del self._dict[key]
+                        self._mutations += 1
+                        return True
+                    return False
+        return self._server.kv_fast_del(key.encode())
 
     def keys(self, prefix: str = "") -> List[str]:
-        if self._server is not None:
-            return [k.decode()
-                    for k in self._server.kv_fast_keys(prefix.encode())]
-        return [k for k in self._dict if k.startswith(prefix)]
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:
+                    return [k for k in self._dict if k.startswith(prefix)]
+        return [k.decode()
+                for k in self._server.kv_fast_keys(prefix.encode())]
 
     def items(self) -> Dict[str, Any]:
-        if self._server is not None:
-            return {k.decode(): pickle.loads(v)
-                    for k, v in self._server.kv_fast_items().items()}
-        return dict(self._dict)
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:
+                    return dict(self._dict)
+        return {k.decode(): pickle.loads(v)
+                for k, v in self._server.kv_fast_items().items()}
+
+    def items_raw(self) -> Dict[str, bytes]:
+        """Pickled-value form — what snapshots store: skips the
+        loads-then-redump round trip over possibly-megabyte blobs."""
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:
+                    return {k: pickle.dumps(v, protocol=5)
+                            for k, v in self._dict.items()}
+        return {k.decode(): v
+                for k, v in self._server.kv_fast_items().items()}
+
+    def put_raw(self, key: str, raw: bytes) -> None:
+        """Restore one snapshot entry (already-pickled value)."""
+        if self._server is None:
+            with self._dict_lock:
+                if self._server is None:
+                    self._dict[key] = pickle.loads(raw)
+                    self._mutations += 1
+                    return
+        self._server.kv_fast_put(key.encode(), raw)
 
     def version(self) -> int:
         """Mutation counter — client fast-path writes bypass Python, so
@@ -230,6 +265,7 @@ class Head:
         # directly to their node when the head forgot the lease.
         self._persist_path = persist_path
         self._persist_dirty = False
+        self._persisted_kv_version = 0  # last KV version snapshotted
         # serializes snapshot WRITES (persist loop vs stop(): two threads
         # sharing one .tmp path would interleave into a torn pickle)
         self._persist_write_lock = threading.Lock()
@@ -363,7 +399,9 @@ class Head:
                   flush=True)
             return
         with self._lock:
-            for k, v in data.get("kv", {}).items():
+            for k, raw in data.get("kv_raw", {}).items():
+                self._kv.put_raw(k, raw)
+            for k, v in data.get("kv", {}).items():  # legacy snapshots
                 self._kv.put(k, v)
             self._next_job = max(self._next_job, data.get("next_job", 0))
             for rec in data.get("actors", ()):
@@ -412,6 +450,15 @@ class Head:
     def _save_snapshot(self) -> None:
         with self._persist_write_lock:
             with self._lock:
+                # KV dirtiness comes from the table's mutation counter
+                # (native fast-path writes never run Python handlers).
+                # Checked HERE, not only in the poll loop, so stop()'s
+                # final snapshot can't miss writes newer than the loop's
+                # last 1s tick.
+                v = self._kv.version()
+                if v != self._persisted_kv_version:
+                    self._persisted_kv_version = v
+                    self._persist_dirty = True
                 if not self._persist_dirty:
                     return
                 actors = []
@@ -426,7 +473,8 @@ class Head:
                     pgs[pid] = {k: pg[k] for k in
                                 ("bundles", "nodes", "state", "strategy",
                                  "name")}
-                snap = {"kv": self._kv.items(), "next_job": self._next_job,
+                snap = {"kv_raw": self._kv.items_raw(),
+                        "next_job": self._next_job,
                         "actors": actors, "named": dict(self._named),
                         "pgs": pgs}
                 self._persist_dirty = False
@@ -445,19 +493,11 @@ class Head:
                 raise
 
     def _persist_loop(self) -> None:
-        last_kv_version = self._kv.version()
         while not self._stopped.is_set():
             self._persist_kick.wait(timeout=1.0)
             self._persist_kick.clear()
             if self._stopped.is_set():
                 return  # stop() takes the final snapshot itself
-            # native-fast-path KV writes never enter Python, so dirtiness
-            # is detected by polling the table's mutation counter
-            v = self._kv.version()
-            if v != last_kv_version:
-                last_kv_version = v
-                with self._lock:
-                    self._persist_dirty = True
             try:
                 self._save_snapshot()
             except Exception:  # noqa: BLE001

@@ -97,6 +97,26 @@ def _unflatten(flat: Dict[str, Any]):
     return convert(root)
 
 
+def gather_to_host(tree):
+    """Materialize a (possibly multi-process global) pytree on THIS host.
+
+    Leaves that span non-addressable devices are assembled with a
+    process_allgather — a COLLECTIVE: every rank must call this with the
+    same tree, even though only rank 0 writes the checkpoint (the
+    multi-host half of "checkpoints re-shard onto a different mesh").
+    Fully-addressable leaves pass through untouched (device_get at save).
+    """
+    import jax
+
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x, tiled=True)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 class Checkpoint:
     """Handle to a checkpoint directory (reference: Checkpoint.from_directory)."""
 

@@ -27,6 +27,16 @@ class ScalingConfig:
     """
 
     num_workers: int = 1
+    #: Elastic training (reference: train/v2 ScalingPolicy seam,
+    #: scaling_policy.py:29): when set below num_workers, the controller
+    #: sizes each (re)schedule to what the cluster can host in
+    #: [min_workers, num_workers] — a lost worker restarts the group one
+    #: smaller (re-meshed + checkpoint-restored) instead of failing the
+    #: run. Requires a -1 "fill" axis in `mesh`.
+    min_workers: Optional[int] = None
+    #: elastic grow-back: how often (seconds) the controller polls cluster
+    #: capacity for a mid-run upscale (interrupt + restore at bigger size)
+    grow_poll_s: float = 30.0
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     use_tpu: bool = False
     chips_per_worker: int = 0

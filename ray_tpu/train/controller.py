@@ -10,11 +10,15 @@ from __future__ import annotations
 
 import enum
 import logging
+import threading
+import time
 from typing import Callable, List, Optional
 
 from ray_tpu.train.checkpoint import CheckpointManager
 from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
 from ray_tpu.train.result import Result
+from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
+                                          FixedScalingPolicy, ScalingPolicy)
 from ray_tpu.train.worker_group import WorkerGroup, WorkerGroupError
 
 logger = logging.getLogger(__name__)
@@ -59,18 +63,80 @@ class TrainController:
             self.storage_path,
             num_to_keep=run_config.checkpoint_config.num_to_keep)
         self.failure_policy = FailurePolicy(run_config.failure_config)
+        self.scaling_policy = self._build_scaling_policy()
+
+    def _build_scaling_policy(self) -> ScalingPolicy:
+        sc = self.scaling
+        if sc.min_workers is not None and sc.min_workers != sc.num_workers:
+            # elastic re-mesh needs a fill axis so the degrees re-derive at
+            # any world size (MeshSpec.resolve over fewer/more devices)
+            if sc.mesh is not None and -1 not in sc.mesh.degrees().values():
+                raise ValueError(
+                    "elastic training (min_workers set) requires a -1 "
+                    "('fill') axis in ScalingConfig.mesh so the mesh can "
+                    f"re-resolve at a new world size; got {sc.mesh}")
+            return ElasticScalingPolicy(sc.min_workers, sc.num_workers,
+                                        sc.worker_resources())
+        return FixedScalingPolicy(sc.num_workers)
+
+    @staticmethod
+    def _capacity() -> dict:
+        import ray_tpu
+        return ray_tpu.available_resources()
+
+    def _start_grow_monitor(self, group: WorkerGroup, size: int,
+                            upscale: dict, stop: "threading.Event") -> None:
+        """Poll the policy for a capacity-gain resize while the group runs;
+        on a grow decision, interrupt the group (it restarts bigger from
+        the latest checkpoint). Reference: train/v2 scaling_policy
+        ResizeDecision mid-run."""
+        if isinstance(self.scaling_policy, FixedScalingPolicy):
+            return  # fixed-size runs never grow; skip the poll thread
+        poll = max(0.2, self.scaling.grow_poll_s)
+
+        def _mon():
+            # Wait until every worker is PLACED before judging capacity:
+            # CPUs the group hasn't claimed yet would read as free and the
+            # monitor would interrupt a group that never started.
+            import ray_tpu
+            try:
+                ray_tpu.get([w.health_check.remote()
+                             for w in group.workers], timeout=300)
+            except Exception:  # noqa: BLE001 — group failing; that path
+                return         # is handled by the failure policy
+            while not stop.wait(poll):
+                try:
+                    target = self.scaling_policy.grow_target(
+                        size, self._capacity)
+                except Exception:  # noqa: BLE001 — capacity probe hiccup
+                    continue
+                if target is not None:
+                    upscale["target"] = target
+                    logger.info("capacity gained: resizing %d -> %d workers",
+                                size, target)
+                    group.interrupt()
+                    return
+
+        threading.Thread(target=_mon, daemon=True,
+                         name="train-grow").start()
 
     def run(self) -> Result:
         history: List[dict] = []
+        size = self.scaling_policy.initial_size(self._capacity)
         while True:
             self.state = ControllerState.SCHEDULING
-            group = WorkerGroup(self.scaling.num_workers,
+            group = WorkerGroup(size,
                                 self.scaling.worker_resources(),
                                 scaling=self.scaling)
             group.start()
+            upscale: dict = {"target": None}
+            stop_mon = threading.Event()
+            self._start_grow_monitor(group, size, upscale, stop_mon)
             try:
                 self.state = ControllerState.RUNNING
                 restore = self.ckpt_manager.latest()
+                logger.info("running %d workers (restore=%s)", size,
+                            restore.path if restore else None)
                 per_worker = group.run(
                     self.train_fn, self.storage_path,
                     self.train_loop_config, restore,
@@ -85,14 +151,34 @@ class TrainController:
                     path=self.storage_path,
                     metrics_history=history)
             except WorkerGroupError as e:
+                if upscale["target"] is not None:
+                    # Deliberate interrupt for a capacity-gain resize — not
+                    # counted as a failure. A GENUINE failure can race the
+                    # interrupt, so don't trust the target blindly: refit
+                    # against post-shutdown capacity (a cluster that just
+                    # lost a worker fits fewer), clamped to the target.
+                    group.shutdown()
+                    time.sleep(1.0)  # let released resources register
+                    fit = self.scaling_policy.initial_size(self._capacity)
+                    size = max(1, min(upscale["target"], fit))
+                    self.state = ControllerState.RESTARTING
+                    continue
                 decision = self.failure_policy.decide(e)
-                logger.warning("worker group failure #%d (%s): %s",
-                               self.failure_policy.failures, decision, e)
+                new_size = self.scaling_policy.after_failure(size, e)
+                logger.warning(
+                    "worker group failure #%d (%s, %d -> %d workers): %s",
+                    self.failure_policy.failures, decision, size, new_size,
+                    e)
                 if decision == "RAISE":
                     self.state = ControllerState.ERRORED
                     return Result(metrics={}, checkpoint=self.ckpt_manager.latest(),
                                   path=self.storage_path,
                                   metrics_history=history, error=e)
+                # elastic re-mesh: the restarted group re-lowers the train
+                # step over the resized device mesh and restores from the
+                # latest checkpoint (host-numpy pytrees re-shard freely)
+                size = new_size
                 self.state = ControllerState.RESTARTING
             finally:
+                stop_mon.set()
                 group.shutdown()

@@ -56,6 +56,12 @@ class TrainContext:
         if self.checkpoint_frequency > 0 \
                 and self.step % self.checkpoint_frequency != 0:
             checkpoint_tree = None
+        if checkpoint_tree is not None:
+            # gather-before-save is a COLLECTIVE when the tree spans
+            # processes (multi-host mesh): every rank participates here,
+            # then only rank 0 touches storage
+            from ray_tpu.train.checkpoint import gather_to_host
+            checkpoint_tree = gather_to_host(checkpoint_tree)
         if checkpoint_tree is not None and self.rank == 0 \
                 and self.ckpt_manager is not None:
             ckpt = self.ckpt_manager.save(checkpoint_tree, self.step, metrics)

@@ -64,7 +64,10 @@ class _TrainWorker:
         finally:
             _set_context(None)
 
+    @ray_tpu.method(concurrency_group="control")
     def health_check(self) -> bool:
+        # served on the "control" lane so it answers while run() occupies
+        # the default lane (reference: train/v2 worker-group health polls)
         return True
 
 
@@ -107,6 +110,7 @@ class WorkerGroup:
             "num_cpus": self.resources.get("CPU", 1.0),
             "resources": {k: v for k, v in self.resources.items()
                           if k != "CPU"} or None,
+            "concurrency_groups": {"control": 1},
         })(_TrainWorker)
         self.workers = [cls.remote(rank, self.num_workers)
                         for rank in range(self.num_workers)]
@@ -164,6 +168,16 @@ class WorkerGroup:
                 except Exception as e:  # noqa: BLE001 — worker fault boundary
                     raise WorkerGroupError(rank, e) from e
         return results
+
+    def interrupt(self) -> None:
+        """Kill the workers so the in-flight run() raises WorkerGroupError
+        — the controller's lever for capacity-gain resizes (the restarted
+        group resumes from the latest checkpoint)."""
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
 
     def shutdown(self) -> None:
         for w in self.workers:
