@@ -291,3 +291,54 @@ def test_elastic_grow_on_capacity_gain(cluster_rt, tmp_path):
     # restored continuation, not a from-scratch restart
     assert result.metrics_history[0]["_step"] > 1, result.metrics_history[0]
     assert result.metrics_history[-1]["_step"] == 40
+
+
+def test_two_slice_hybrid_mesh_across_processes(cluster_rt):
+    """2 worker processes x 4 devices = 2 'slices': dp spans slices (DCN)
+    while fsdp stays inside each process's devices (ICI) — the multi-slice
+    hybrid mesh trained through the real multi-process path
+    (MeshSpec.dcn_dp; slice grouping falls out of process_index)."""
+    def loop(cfg):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ray_tpu.models import llama
+        from ray_tpu.train.train_step import (make_train_step, shard_batch,
+                                              shard_params)
+
+        ctx = train.get_context()
+        mesh = ctx.global_mesh()
+        assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 4,
+                                    "sp": 1, "tp": 1}, mesh.shape
+        # slice locality: each dp block's devices live on ONE process
+        for b in range(2):
+            procs = {d.process_index for d in mesh.devices[:, b].flatten()}
+            assert len(procs) == 1, (b, procs)
+
+        mcfg = llama.LlamaConfig.tiny(n_layers=2)
+        params = llama.init_params(mcfg, jax.random.PRNGKey(11))
+        with mesh:
+            params = shard_params(params, mesh, llama.param_specs(mcfg))
+            init_fn, step_fn = make_train_step(
+                lambda p, b: llama.loss_fn(p, b, mcfg), optax.sgd(1e-2))
+            opt_state = init_fn(params)
+            rng = np.random.default_rng(11)
+            batch = rng.integers(0, mcfg.vocab_size, (8, 32)).astype(np.int32)
+            batch = shard_batch(jnp.asarray(batch), mesh)
+            params, opt_state, metrics = step_fn(params, opt_state, batch)
+            loss = float(metrics["loss"])
+            assert np.isfinite(loss)
+            train.report({"loss": loss})
+
+    result = train.JaxTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(
+            num_workers=2,
+            mesh=MeshSpec(dcn_dp=2, fsdp=-1),
+            jax_distributed=True,
+            jax_platform="cpu",
+            local_device_count=4),
+        run_config=train.RunConfig(name="hybrid2")).fit()
+    assert result.error is None, result.error

@@ -64,6 +64,36 @@ def test_chip_allocator():
     assert alloc.allocate(b"w3", 2) == a
 
 
+def test_multislice_pg_one_bundle_per_slice():
+    """Multi-slice job placement: one slice-head gang bundle PER SLICE
+    lands on distinct slices atomically — the placement half of the
+    ICI x DCN hybrid mesh (parallel/mesh.py MeshSpec.dcn_dp: dp/pp span
+    slices over DCN, so a 2-slice job reserves 2 whole slices)."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, resources={
+        "TPU": 4, "TPU-v5e": 4, "TPU-v5e-8-head": 1})
+    cluster.add_node(num_cpus=1, resources={
+        "TPU": 4, "TPU-v5e": 4, "TPU-v5e-8-head": 1})
+    rt.init(address=cluster.address)
+    try:
+        pg = placement_group(
+            [{"TPU-v5e-8-head": 1}, {"TPU-v5e-8-head": 1}],
+            strategy="STRICT_SPREAD")
+        assert pg.wait(30)
+        nodes = pg.state()["nodes"]
+        assert len(set(nodes)) == 2  # one bundle per slice
+        # both slices are now taken: a third slice reservation queues
+        pg2 = placement_group([{"TPU-v5e-8-head": 1}],
+                              strategy="STRICT_PACK")
+        assert not pg2.wait(1.5)
+        remove_placement_group(pg)
+        assert pg2.wait(30)
+        remove_placement_group(pg2)
+    finally:
+        rt.shutdown()
+        cluster.shutdown()
+
+
 # ------------------------------------------------- cluster: PG semantics
 
 
@@ -167,5 +197,3 @@ def test_tpu_gang_reservation(pg_cluster):
     remove_placement_group(pg)
     assert pg2.wait(30)
     remove_placement_group(pg2)
-
-
