@@ -243,7 +243,13 @@ def _make_attn_fn(cfg: LlamaConfig, mesh):
     if mesh is None:
         raise ValueError(f"attention={cfg.attention!r} needs a mesh")
     if cfg.attention == "ring":
-        return functools.partial(ring_attention_sharded, mesh=mesh)
+        # pp > 1 runs attention inside the PARTIAL-manual pipeline
+        # shard_map where fsdp/tp stay GSPMD-auto — a Mosaic pallas_call
+        # cannot be auto-partitioned there, so force the einsum ring path
+        # (full-manual single-stage meshes keep the fused auto-default)
+        use_kernel = False if mesh.shape.get("pp", 1) > 1 else None
+        return functools.partial(ring_attention_sharded, mesh=mesh,
+                                 use_kernel=use_kernel)
     if cfg.attention == "ulysses":
         return functools.partial(ulysses_attention_sharded, mesh=mesh)
     raise ValueError(f"unknown attention {cfg.attention!r}")

@@ -252,12 +252,17 @@ def _fwd_call(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
 
 
 def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, blk_q, blk_k,
-              interpret):
+              interpret, dlse=None):
     BH, Lq, D = q.shape
     Lk = k.shape[1]
     blk_q, blk_k = min(blk_q, Lq), min(blk_k, Lk)
     delta = jnp.einsum("bld,bld->bl", do.astype(jnp.float32),
                        o.astype(jnp.float32))
+    if dlse is not None:
+        # lse cotangent folds into delta: ds = p∘(dP − delta + dlse)
+        # because d lse/d s = p — so the kernels run unchanged with
+        # delta' = delta − dlse (the flash_attention_block merge path)
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[:, None, :], (BH, 8, Lq))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
@@ -319,34 +324,71 @@ def _blhd(x, B, H):
     return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     blk_q: int = 256, blk_k: int = 256,
                     interpret: bool = False) -> jax.Array:
-    """[B, L, H, D] flash attention; Pallas fwd+bwd, O(L·blk) memory."""
-    out, _ = _vjp_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret)
+    """[B, L, H, D] flash attention; Pallas fwd+bwd, O(L·blk) memory.
+
+    Thin facade over flash_attention_block (which also exposes lse for the
+    ring-attention merge); the discarded lse output contributes a zero
+    cotangent that the shared backward folds away."""
+    return flash_attention_block(q, k, v, causal, sm_scale, blk_q, blk_k,
+                                 interpret)[0]
+
+
+# --------------------------------------------------------------------------
+# Block API: (o, lse) with differentiable lse — the ring-attention inner
+# kernel (per-rotation fused block whose results merge by log-sum-exp)
+# --------------------------------------------------------------------------
+
+def pick_block(L: int, preferred: int = 256) -> Optional[int]:
+    """Largest kernel block size <= preferred that divides L (Pallas grid
+    constraint); None when L has no power-of-two divisor. Sub-8 blocks
+    only occur on tiny test shards (interpret mode) — real TPU shapes tile
+    at >= 8 sublanes."""
+    for b in (preferred, 128, 64, 32, 16, 8, 4, 2, 1):
+        if b <= preferred and L % b == 0:
+            return min(b, L)
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_block(q, k, v, causal: bool = True,
+                          sm_scale: Optional[float] = None,
+                          blk_q: int = 256, blk_k: int = 256,
+                          interpret: bool = False):
+    """Fused attention of q against ONE KV block: returns (o [B,L,H,D],
+    lse [B,H,Lq]). lse is differentiable — its cotangent (nonzero when
+    block results are merged across ring rotations) folds into the
+    backward kernels' delta term, so the same Pallas kernels serve both
+    the standalone and the ring-merged case."""
+    out, _ = _block_vjp_fwd(q, k, v, causal, sm_scale, blk_q, blk_k,
+                            interpret)
     return out
 
 
-def _vjp_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
+def _block_vjp_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
     B, Lq, H, D = q.shape
     scale = sm_scale if sm_scale is not None else D ** -0.5
     o, lse = _fwd_call(_bhl(q), _bhl(k), _bhl(v), causal, scale,
                        blk_q, blk_k, interpret)
-    return _blhd(o, B, H), (q, k, v, o, lse)
+    lse_bhl = lse[:, 0, :].reshape(B, H, Lq)
+    return (_blhd(o, B, H), lse_bhl), (q, k, v, o, lse)
 
 
-def _vjp_bwd(causal, sm_scale, blk_q, blk_k, interpret, res, g):
+def _block_vjp_bwd(causal, sm_scale, blk_q, blk_k, interpret, res, g):
+    do, dlse = g
     q, k, v, o, lse = res
     B, Lq, H, D = q.shape
     scale = sm_scale if sm_scale is not None else D ** -0.5
-    dq, dk, dv = _bwd_call(_bhl(q), _bhl(k), _bhl(v), o, lse, _bhl(g),
-                           causal, scale, blk_q, blk_k, interpret)
+    dq, dk, dv = _bwd_call(_bhl(q), _bhl(k), _bhl(v), o, lse, _bhl(do),
+                           causal, scale, blk_q, blk_k, interpret,
+                           dlse=dlse.reshape(B * H, Lq))
     return _blhd(dq, B, H), _blhd(dk, B, H), _blhd(dv, B, H)
 
 
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+flash_attention_block.defvjp(_block_vjp_fwd, _block_vjp_bwd)
 
 
 def kernels_supported() -> bool:

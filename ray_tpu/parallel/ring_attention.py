@@ -41,20 +41,113 @@ def _block_update(o, m, l, s, v):
     return o_new, m_new, l_new
 
 
+def _merge_blocks(o1, lse1, o2, lse2):
+    """Log-sum-exp merge of two normalized attention results.
+
+    o*: [B,Lq,H,D] f32 (softmax-normalized); lse*: [B,H,Lq] f32. An lse of
+    -inf marks "no keys seen yet" and contributes weight 0.
+    """
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - m))
+    w2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - m))
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    wt1 = (w1 / denom).transpose(0, 2, 1)[..., None]
+    wt2 = (w2 / denom).transpose(0, 2, 1)[..., None]
+    return o1 * wt1 + o2 * wt2, m + jnp.log(denom)
+
+
+def _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret):
+    """Ring loop whose per-rotation compute is the Pallas flash block
+    kernel (ops/flash_attention.py): KV streams through VMEM fused with
+    the online softmax on the MXU while lax.ppermute rotates the next
+    block — no [B,H,Lq,Lk] scores ever land in HBM. Per-rotation results
+    (normalized o + lse) combine by log-sum-exp; lse stays differentiable
+    through the merge (its cotangent folds into the backward kernels'
+    delta term)."""
+    from ray_tpu.ops.flash_attention import flash_attention_block, pick_block
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    blk_q = pick_block(Lq)
+    blk_k = pick_block(k.shape[1])
+    if blk_q is None or blk_k is None:
+        raise ValueError(
+            f"ring fused kernel needs block-divisible shard lengths, got "
+            f"Lq={Lq}, Lk={k.shape[1]} (pass use_kernel=False)")
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, Lq), _NEG_INF, jnp.float32)
+
+    def step(carry, t):
+        o, lse, kt, vt = carry
+        src = (idx - t) % n  # ring origin of the KV block currently held
+
+        def attend(args):
+            o, lse, kt, vt = args
+            # diagonal block: standard causal mask (same seq origin);
+            # strictly-past blocks: fully visible
+            if causal:
+                # custom_vjp takes positional args only
+                ob, lb = lax.cond(
+                    src == idx,
+                    lambda a: flash_attention_block(
+                        a[0], a[1], a[2], True, sm_scale, blk_q, blk_k,
+                        interpret),
+                    lambda a: flash_attention_block(
+                        a[0], a[1], a[2], False, sm_scale, blk_q, blk_k,
+                        interpret),
+                    (q, kt, vt))
+            else:
+                ob, lb = flash_attention_block(
+                    q, kt, vt, False, sm_scale, blk_q, blk_k, interpret)
+            return _merge_blocks(o, lse, ob.astype(jnp.float32), lb)
+
+        if causal:
+            # future blocks (src > idx) are fully masked: skip the kernel
+            o, lse = lax.cond(src <= idx, attend,
+                              lambda a: (a[0], a[1]), (o, lse, kt, vt))
+        else:
+            o, lse = attend((o, lse, kt, vt))
+        kt = ppermute_shift(kt, axis_name)
+        vt = ppermute_shift(vt, axis_name)
+        return (o, lse, kt, vt), None
+
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = True,
-                   sm_scale: Optional[float] = None) -> jax.Array:
+                   sm_scale: Optional[float] = None,
+                   use_kernel: Optional[bool] = None,
+                   interpret: bool = False) -> jax.Array:
     """Ring attention over `axis_name`; call INSIDE shard_map/pjit manual axes.
 
     q, k, v: [batch, seq_local, heads, head_dim], contiguous seq blocks in
     ring order along `axis_name`. Returns [batch, seq_local, heads, head_dim].
+
+    use_kernel: run the per-rotation compute in the fused Pallas flash
+    kernel (None = auto: on when the Mosaic kernels lower on this
+    platform). The einsum path below remains the numerics reference.
     """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if use_kernel is None:
+        from ray_tpu.ops.flash_attention import (kernels_supported,
+                                                 pick_block)
+        # auto: fused only where the Mosaic kernels lower AND the shard
+        # lengths divide into kernel blocks; else the einsum path below
+        use_kernel = (kernels_supported()
+                      and pick_block(q.shape[1]) is not None
+                      and pick_block(k.shape[1]) is not None)
+    if use_kernel:
+        return _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret)
+
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    if sm_scale is None:
-        sm_scale = D ** -0.5
     qf = q.astype(jnp.float32) * sm_scale
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
@@ -92,10 +185,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_attention_sharded(q, k, v, mesh, *, causal: bool = True,
                            seq_axis: str = "sp", head_axis: str = "tp",
-                           batch_axes=("dp", "fsdp")) -> jax.Array:
+                           batch_axes=("dp", "fsdp"),
+                           use_kernel: Optional[bool] = None,
+                           interpret: bool = False) -> jax.Array:
     """shard_map wrapper: seq sharded on `seq_axis`, heads on `head_axis`."""
     spec = P(batch_axes, seq_axis, head_axis, None)
     fn = shard_map_compat(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          use_kernel=use_kernel, interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
