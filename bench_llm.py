@@ -27,24 +27,30 @@ def main() -> None:
                           decode_chunk=32)
     prompt = [(7 * i + 3) % cfg.vocab_size for i in range(128)]
 
-    # --- TTFT: request arrival -> first token sampled (includes prefill)
+    # --- TTFT: request arrival -> first token sampled (includes prefill).
+    # LOCKED PROTOCOL (round-3 verdict: cross-run tunnel variance was
+    # ±40%, so the claim must hold within ONE process): after the compile
+    # warmup, measure THREE consecutive groups of 7 samples each and
+    # report every group's p50. The target is met only if ALL THREE p50s
+    # beat it — the headline value is the WORST of the three.
     eng.add_request(prompt, max_new_tokens=1)
     t0 = time.perf_counter()
     eng.step()           # admit + prefill + first token
     ttft_cold = time.perf_counter() - t0   # includes compile
     while eng.has_work():
         eng.step()
-    samples = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        eng.add_request(prompt, max_new_tokens=1)
-        eng.step()
-        samples.append(time.perf_counter() - t0)
-        while eng.has_work():
+    group_p50s = []
+    for _group in range(3):
+        samples = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            eng.add_request(prompt, max_new_tokens=1)
             eng.step()
-    ttft = sorted(samples)[len(samples) // 2]  # true p50 over 7 samples
-    while eng.has_work():
-        eng.step()
+            samples.append(time.perf_counter() - t0)
+            while eng.has_work():
+                eng.step()
+        group_p50s.append(sorted(samples)[len(samples) // 2])
+    ttft = max(group_p50s)  # worst consecutive p50 carries the claim
 
     # --- TTFT under queue depth: 8 prompts arrive AT ONCE; per-request
     # TTFT = its own first-token time minus the shared arrival instant
@@ -82,7 +88,11 @@ def main() -> None:
     out = [
         {"metric": "llm_ttft_p50", "value": round(ttft * 1000, 2),
          "unit": "ms", "vs_baseline": round(200.0 / (ttft * 1000), 2),
-         "note": "128-tok prompt prefill + first token, 202M model, "
+         "group_p50s_ms": [round(p * 1000, 2) for p in group_p50s],
+         "meets_target": bool(all(p * 1000 < 200.0 for p in group_p50s)),
+         "note": "WORST of 3 consecutive same-process p50s (7 samples "
+                 "each); 128-tok prompt prefill + argmax fused into one "
+                 "program = ONE scalar readback per TTFT; 202M model, "
                  "1 chip; baseline = 200ms north-star target"},
         {"metric": "llm_ttft_queued_mean", "value": round(ttft_q * 1000, 2),
          "unit": "ms", "vs_baseline": round(200.0 / (ttft_q * 1000), 2),

@@ -39,6 +39,22 @@ from ray_tpu.models.llama import LlamaConfig, init_params
 from ray_tpu.ops.paged_attention import write_prefill_kv
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill_tok(params, tokens, true_len, cfg):
+    """prefill + argmax in ONE compiled program: TTFT is round-trip-bound
+    (on a tunneled chip each blocking readback is ~120ms), so the first
+    token must come back in a single scalar read with no intermediate
+    eager dispatch between prefill and argmax."""
+    logits, k_all, v_all = prefill(params, tokens, true_len, cfg)
+    return jnp.argmax(logits), k_all, v_all
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill_many_tok(params, tokens, true_lens, cfg):
+    logits, k_n, v_n = prefill_many(params, tokens, true_lens, cfg)
+    return jnp.argmax(logits, axis=-1), k_n, v_n
+
+
 @functools.partial(jax.jit, static_argnames=("t_page",),
                    donate_argnames=("k_cache", "v_cache"))
 def _write_prefill_pages(k_cache, v_cache, k_all, v_all, true_len, pages,
@@ -202,10 +218,9 @@ class InferenceEngine:
             T = len(seq.prompt)
             tokens = np.zeros((1, Tpad), np.int32)
             tokens[0, :T] = seq.prompt
-            logits, k_all, v_all = prefill(
+            tok, k_all, v_all = _prefill_tok(
                 self.params, jnp.asarray(tokens), jnp.int32(T), self.cfg)
-            self._postfill(seq, slot, pages, int(jnp.argmax(logits)),
-                           k_all, v_all)
+            self._postfill(seq, slot, pages, int(tok), k_all, v_all)
             return
         # batched path: pad the group to a power-of-two size so compile
         # count stays |size buckets| x |length buckets|, not one program
@@ -217,12 +232,12 @@ class InferenceEngine:
         for i, (seq, _, _) in enumerate(group):
             tokens[i, :len(seq.prompt)] = seq.prompt
             lens[i] = len(seq.prompt)
-        logits_n, k_n, v_n = prefill_many(
+        toks_n, k_n, v_n = _prefill_many_tok(
             self.params, jnp.asarray(tokens), jnp.asarray(lens), self.cfg)
-        # ONE blocking readback for the whole group's first tokens; the
-        # per-sequence KV writes below are async dispatches, so the group
-        # costs ~2 host round-trips instead of 2N
-        first_toks = np.asarray(jnp.argmax(logits_n, axis=-1))
+        # ONE blocking readback for the whole group's first tokens (argmax
+        # fused into the prefill program); the per-sequence KV writes below
+        # are async dispatches, so the group costs ~1 host round-trip
+        first_toks = np.asarray(toks_n)
         for i, (seq, slot, pages) in enumerate(group):
             self._postfill(seq, slot, pages, int(first_toks[i]),
                            k_n[i], v_n[i])

@@ -342,13 +342,15 @@ def flash_attention(q, k, v, causal: bool = True,
 # kernel (per-rotation fused block whose results merge by log-sum-exp)
 # --------------------------------------------------------------------------
 
-def pick_block(L: int, preferred: int = 256) -> Optional[int]:
+def pick_block(L: int, preferred: int = 256, min_block: int = 8
+               ) -> Optional[int]:
     """Largest kernel block size <= preferred that divides L (Pallas grid
-    constraint); None when L has no power-of-two divisor. Sub-8 blocks
-    only occur on tiny test shards (interpret mode) — real TPU shapes tile
-    at >= 8 sublanes."""
+    constraint); None when no divisor >= min_block exists. The default
+    floor of 8 matches the Mosaic sublane tiling — auto-selection must
+    fall back to the einsum path below it; explicit (interpret-mode test)
+    callers pass min_block=1 for tiny shards."""
     for b in (preferred, 128, 64, 32, 16, 8, 4, 2, 1):
-        if b <= preferred and L % b == 0:
+        if min_block <= b <= preferred and L % b == 0:
             return min(b, L)
     return None
 

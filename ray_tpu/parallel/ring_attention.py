@@ -69,12 +69,10 @@ def _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret):
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
-    blk_q = pick_block(Lq)
-    blk_k = pick_block(k.shape[1])
-    if blk_q is None or blk_k is None:
-        raise ValueError(
-            f"ring fused kernel needs block-divisible shard lengths, got "
-            f"Lq={Lq}, Lk={k.shape[1]} (pass use_kernel=False)")
+    # explicit use_kernel=True (incl. interpret-mode tests) may run sub-8
+    # blocks; AUTO selection filtered on the >= 8 floor already
+    blk_q = pick_block(Lq, min_block=1)
+    blk_k = pick_block(k.shape[1], min_block=1)
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     lse0 = jnp.full((B, H, Lq), _NEG_INF, jnp.float32)
