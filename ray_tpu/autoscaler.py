@@ -10,9 +10,10 @@ and terminates nodes idle beyond the timeout.
 
 ``LocalNodeProvider`` launches node daemons as local subprocesses — the
 reference's fake_multi_node provider trick (SURVEY §4 item 3) promoted to
-the first-class test/dev provider. A cloud TPU-VM provider would plug in
-here by implementing the same two NodeProvider methods against the GCE
-API (none ships in-tree: this image has no cloud access to test one).
+the first-class test/dev provider. The cloud provider is
+``ray_tpu.providers.gcp_tpu.TpuVmNodeProvider``: one TPU slice per node
+through the GCE TPU REST API (HTTP transport injectable — tests exercise
+it against a fake since this image has no cloud egress).
 """
 
 from __future__ import annotations
@@ -143,9 +144,12 @@ class Autoscaler:
             handle = self.provider.create_node(self.node_type)
             self._pending.append(handle)
             self._handles.append(handle)
-        if not state["demand"]:
-            # never shrink while shapes are pending — a node idle between
-            # two task waves would flap (terminate -> relaunch)
+        if need == 0:
+            # Never shrink while SERVICEABLE shapes are pending — a node
+            # idle between two task waves would flap. Demand this
+            # node_type can never satisfy (an infeasible gang bundle)
+            # must NOT block drain forever, hence need==0 rather than
+            # raw-demand-empty.
             self._scale_down(state["nodes"])
 
     def _adopt_registered(self, nodes: List[dict]) -> None:
@@ -204,17 +208,27 @@ class Autoscaler:
             first_idle = self._idle_since.setdefault(nid, now)
             if removable > 0 and now - first_idle >= self.idle_timeout_s:
                 logger.info("autoscaler: terminating idle node %s", nid[:12])
-                self._launched.pop(nid)
+                handle = self._launched.pop(nid)
                 self._idle_since.pop(nid, None)
-                # terminate via the node's own shutdown RPC, addressed by
-                # node_id: Popen handles and node ids were paired
-                # arbitrarily at adoption, so killing by handle could hit
-                # a BUSY sibling launched in the same reconcile
+                # drain via the node's own shutdown RPC, addressed by
+                # node_id (handles and node ids were paired by launch
+                # identity, but the daemon exits cleanest by RPC)...
+                drain = RpcClient(n["address"], name="asc-drain")
                 try:
-                    RpcClient(n["address"], name="asc-drain").call(
-                        "shutdown", {}, timeout=5.0)
+                    drain.call("shutdown", {}, timeout=5.0)
                 except RpcError:
-                    pass  # already dead; handle reaped at stop()
+                    pass  # already dead
+                finally:
+                    drain.close()
+                # ...then release the underlying machine through the
+                # provider — for a cloud provider this is the API call
+                # that actually stops billing (a local Popen terminate is
+                # an idempotent no-op after the RPC shutdown)
+                try:
+                    self.provider.terminate_node(handle)
+                except Exception:  # noqa: BLE001
+                    logger.exception("terminate_node failed for %s", nid[:12])
+                self._handles = [h for h in self._handles if h is not handle]
                 removable -= 1
 
 

@@ -1,0 +1,5 @@
+"""Cloud node providers for the autoscaler's NodeProvider seam."""
+
+from ray_tpu.providers.gcp_tpu import TpuVmNodeProvider
+
+__all__ = ["TpuVmNodeProvider"]

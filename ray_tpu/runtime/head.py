@@ -1333,12 +1333,24 @@ class Head:
                 # not request more nodes than it can use at once)
                 demand.extend([dict(d["resources"])] *
                               min(d["count"], 16))
+            # Unplaced placement-group bundles are demand too — the TPU
+            # gang path: a pending {TPU-{pod}-head: 1} bundle asks the
+            # autoscaler for a whole slice (reference:
+            # gcs_autoscaler_state_manager reports pending gang requests)
+            for pg in self._pgs.values():
+                if pg["state"] == "PENDING":
+                    demand.extend(dict(b) for b in pg["bundles"])
             busy_nodes = set()
             for lease in self._leases.values():
                 busy_nodes.add(lease.node_id)
             for e in self._actors.values():
                 if e.state in (ALIVE, PENDING, RESTARTING) and e.node_id:
                     busy_nodes.add(e.node_id)
+            # a CREATED placement group is a live reservation: its nodes
+            # must never be idle-drained out from under it
+            for pg in self._pgs.values():
+                if pg["state"] == "CREATED":
+                    busy_nodes.update(pg.get("nodes") or ())
             nodes = [{"node_id": n.node_id, "alive": n.alive,
                       "address": n.address,
                       "resources": n.resources,

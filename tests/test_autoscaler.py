@@ -58,3 +58,96 @@ def test_scale_up_then_down():
         except Exception:
             pass
         cluster.shutdown()
+
+
+def test_tpu_slice_gang_scale_up_and_drain():
+    """A pending STRICT_PACK slice-head PG drives ONE slice creation
+    through the (mocked) GCE TPU API; once the slice 'joins' and the PG
+    is removed, idle drain deletes the slice via the API (VERDICT #6
+    done-criterion; reference: autoscaler/_private/gcp/node_provider.py)."""
+    from ray_tpu.providers.gcp_tpu import TpuVmNodeProvider
+    from ray_tpu.runtime.cluster_backend import start_head, start_node
+    from ray_tpu.runtime.protocol import RpcClient, RpcError
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    import os
+
+    class FakeGceHttp:
+        def __init__(self):
+            self.requests = []
+
+        def request(self, method, url, body=None):
+            self.requests.append((method, url, body))
+            return {"name": "operations/fake-op", "done": True}
+
+    session = os.urandom(4).hex()
+    head_proc, address = start_head(session)
+    static_node = start_node(address, session, resources={"CPU": 1.0})
+    probe = RpcClient(address, name="gang-test")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if any(n["alive"] for n in probe.call("list_nodes", timeout=5)):
+                break
+        except RpcError:
+            pass
+        time.sleep(0.1)
+
+    fake = FakeGceHttp()
+    provider = TpuVmNodeProvider(
+        project="proj", zone="us-central2-b",
+        accelerator_type="v5litepod-8", runtime_version="tpu-ubuntu2204",
+        head_addr=address, session=session, http=fake)
+    slice_shape = TpuVmNodeProvider.slice_node_type("v5litepod-8")
+    scaler = Autoscaler(address, provider, node_type=slice_shape,
+                        max_workers=1, idle_timeout_s=2.0,
+                        poll_period_s=0.3).start()
+    joined = None
+    try:
+        rt.init(address=address,
+                _system_config={"infeasible_grace_s": 60.0})
+        pg = placement_group([{"TPU-v5e-8-head": 1}],
+                             strategy="STRICT_PACK")
+        # pending gang bundle -> exactly one slice-create API call
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not fake.requests:
+            time.sleep(0.1)
+        creates = [r for r in fake.requests if r[0] == "POST"]
+        assert len(creates) == 1, fake.requests
+        method, url, body = creates[0]
+        assert "tpu.googleapis.com" in url and "nodes?nodeId=rtpu-" in url
+        assert body["acceleratorType"] == "v5litepod-8"
+        assert address in body["metadata"]["startup-script"]
+        # capped at max_workers: no second create even while pending
+        time.sleep(1.0)
+        assert len([r for r in fake.requests if r[0] == "POST"]) == 1
+
+        # 'slice boots': stand in for the TPU VM with a local daemon that
+        # registers under the provisioned node identity + slice resources
+        node_id = scaler._handles[0].rtpu_node_id
+        joined = start_node(address, session, resources=slice_shape,
+                            node_id=node_id)
+        assert pg.wait(30), "gang PG never placed on the joined slice"
+        remove_placement_group(pg)
+
+        # idle past the timeout -> the slice is RELEASED via the API
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if any(r[0] == "DELETE" for r in fake.requests):
+                break
+            time.sleep(0.2)
+        deletes = [r for r in fake.requests if r[0] == "DELETE"]
+        assert len(deletes) == 1, fake.requests
+        assert deletes[0][1].endswith(url.split("?nodeId=")[1]), deletes
+    finally:
+        rt.shutdown()
+        scaler.stop()
+        probe.close()
+        for proc in (joined, static_node, head_proc):
+            if proc is None:
+                continue
+            try:
+                proc.terminate()
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
