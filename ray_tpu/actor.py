@@ -144,6 +144,13 @@ class ActorMethod:
             return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node over this actor method (reference:
+        dag/class_node.py ClassMethodNode); chains compile into
+        pre-launched channel-fed loops via dag.experimental_compile."""
+        from ray_tpu.dag import ActorMethodNode
+        return ActorMethodNode(self._handle, self._method_name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError("actor methods must be invoked with .remote()")
 

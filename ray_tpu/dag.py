@@ -132,11 +132,220 @@ class CompiledDAG:
         return self._compiled(x)
 
 
-def experimental_compile(dag: DAGNode) -> CompiledDAG:
-    """Fuse a DAG of PURE, jax-traceable stage functions into a single
-    XLA program. Stages with side effects, actor state, or non-jax
-    Python control flow must stay on the task path (``execute()``)."""
+class ActorMethodNode(DAGNode):
+    """A bound ACTOR method call in a lazy graph (reference:
+    dag/class_node.py ClassMethodNode). Created via
+    ``actor_handle.method.bind(...)``."""
+
+    def __init__(self, handle, method_name: str, args: tuple, kwargs: dict):
+        self._handle = handle
+        self._method_name = method_name
+        self._args = args
+        self._kwargs = kwargs
+
+    def _submit(self, cache: Dict[int, Any]):
+        if id(self) in cache:
+            return cache[id(self)]
+
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return v._submit(cache)
+            if isinstance(v, InputNode):
+                return v._value()
+            return v
+
+        args = tuple(resolve(a) for a in self._args)
+        kwargs = {k: resolve(v) for k, v in self._kwargs.items()}
+        ref = getattr(self._handle, self._method_name).remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+    def __repr__(self):
+        return f"ActorMethodNode({self._method_name})"
+
+
+class DagRef:
+    """Result handle for one CompiledActorDAG execution. Results arrive
+    on the output channel in submission order; get() drains the channel
+    up to this execution's slot."""
+
+    def __init__(self, owner: "CompiledActorDAG", seq: int):
+        self._owner = owner
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = 60.0):
+        return self._owner._result(self._seq, timeout)
+
+
+class CompiledActorDAG:
+    """Pre-launched per-actor execution loops wired by shm channel rings
+    (reference: dag/compiled_dag_node.py:767 — do_exec_tasks at :188 +
+    experimental/channel/): compile() starts a long-lived loop on every
+    participating actor that reads its input ring, runs the bound method,
+    and writes its output ring. execute(x) writes the input ring and
+    returns a DagRef — no per-call task submission, scheduling, or RPC;
+    ring capacity gives pipelining across executions.
+
+    Constraints (v1, mirrors the reference's aDAG restrictions): the
+    graph must be a linear chain InputNode -> a.m -> b.m -> ...; all
+    actors must live on the driver's node (channels ride the node's shm
+    arena — the cross-node extension is a channel proxied over the
+    object plane); while compiled, eager calls to the same actors race
+    the loop thread against the task queue.
+    """
+
+    def __init__(self, dag: ActorMethodNode, capacity: int = 8,
+                 start_timeout: float = 60.0):
+        import os
+
+        from ray_tpu.core.worker import require_connected
+        from ray_tpu.runtime.channel import ShmChannel
+        from ray_tpu.runtime.protocol import RpcError
+
+        chain = _linear_actor_chain(dag)
+        worker = require_connected()
+        backend = worker.backend
+        store = backend.object_plane.store
+        base = os.urandom(6).hex()
+        names = [f"{base}-{i}" for i in range(len(chain) + 1)]
+        self._backend = backend
+        self._names = names
+        self._store = store
+        self._capacity = capacity
+        self._in = ShmChannel(store, names[0], capacity)
+        self._out = ShmChannel(store, names[-1], capacity)
+        self._next_seq = 0
+        self._done_seq = -1
+        self._results: Dict[int, Any] = {}
+        self._torn_down = False
+        import time as _time
+        for i, (handle, method) in enumerate(chain):
+            deadline = _time.monotonic() + start_timeout
+            addr = None
+            while _time.monotonic() < deadline:
+                info = backend.head.call_retrying(
+                    "get_actor", {"actor_id": handle._actor_id.binary()})
+                if info is None:
+                    raise ValueError(f"actor {handle!r} is not registered")
+                if info["state"] == "ALIVE":
+                    addr = info["address"]
+                    break
+                if info["state"] == "DEAD":
+                    raise ValueError(f"actor {handle!r} is dead: "
+                                     f"{info.get('reason')}")
+                _time.sleep(0.05)
+            if addr is None:
+                raise TimeoutError(f"actor {handle!r} never became ALIVE")
+            try:
+                actor_node = backend.peers.get(addr).call(
+                    "dag_start_loop", {
+                        "in": names[i], "out": names[i + 1],
+                        "method": method, "capacity": capacity}, timeout=30)
+            except RpcError as e:
+                raise RuntimeError(
+                    f"failed to start dag loop on {handle!r}: {e}") from e
+            # channels ride the node's shm arena: a cross-node actor would
+            # attach a DIFFERENT store and the pipeline would hang — fail
+            # loudly at compile time instead
+            if actor_node != backend.local_node_id:
+                self.teardown()
+                raise ValueError(
+                    f"compiled actor DAGs require every actor on the "
+                    f"driver's node: {handle!r} is on node "
+                    f"{str(actor_node)[:12]}, driver on "
+                    f"{str(backend.local_node_id)[:12]}")
+
+    def execute(self, x) -> DagRef:
+        if self._torn_down:
+            raise RuntimeError("compiled dag was torn down")
+        # Sliding window: when every ring is full, the single-threaded
+        # driver must CONSUME a finished result to free a slot — blocking
+        # in put would deadlock the pipeline against itself.
+        while not self._in.try_put(("v", x)):
+            if self._done_seq + 1 < self._next_seq:
+                self._results[self._done_seq + 1] = self._out.get(60.0)
+                self._done_seq += 1
+            else:  # nothing in flight: the ring is jammed, not full
+                self._in.put(("v", x), timeout=60.0)
+                break
+        ref = DagRef(self, self._next_seq)
+        self._next_seq += 1
+        return ref
+
+    def _result(self, seq: int, timeout: Optional[float]):
+        if seq in self._results:
+            tag, val = self._results.pop(seq)
+        elif self._done_seq >= seq:
+            raise ValueError(f"DagRef #{seq} was already consumed")
+        else:
+            while self._done_seq < seq:
+                tag_val = self._out.get(timeout)
+                self._done_seq += 1
+                if self._done_seq == seq:
+                    tag, val = tag_val
+                    break
+                self._results[self._done_seq] = tag_val
+        if tag == "e":
+            raise val
+        return val
+
+    def teardown(self) -> None:
+        """Stop the actor loops (sentinel cascades down the chain) and
+        free the channel slots."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        from ray_tpu.runtime.channel import ChannelClosed, ShmChannel
+        # the input ring may be full of unconsumed work: unjam by draining
+        # outputs until the sentinel fits, or the loop threads never stop
+        for _ in range(64):
+            if self._in.close(timeout=1.0):
+                break
+            try:
+                self._out.get(timeout=5.0)
+            except (ChannelClosed, TimeoutError):
+                break
+        try:
+            # drain until the sentinel falls out of the last channel
+            while True:
+                self._out.get(timeout=10.0)
+        except (ChannelClosed, TimeoutError):
+            pass
+        for name in self._names:
+            ShmChannel(self._store, name, self._capacity).drain()
+
+
+def experimental_compile(dag: DAGNode, **opts):
+    """Compile a bound graph for repeated execution.
+
+    - Pure-function DAGs fuse into ONE XLA program (CompiledDAG):
+      intermediates never leave HBM; stage boundaries cost nothing.
+    - Actor-method chains compile into pre-launched per-actor loops fed
+      by shm channel rings (CompiledActorDAG) — the multi-process
+      pipeline the reference calls aDAG.
+    """
+    if isinstance(dag, ActorMethodNode):
+        return CompiledActorDAG(dag, **opts)
     return CompiledDAG(dag)
+
+
+def _linear_actor_chain(root: ActorMethodNode):
+    """Validate + extract the chain [(handle, method), ...] root-last."""
+    chain = []
+    node: Any = root
+    while isinstance(node, ActorMethodNode):
+        deps = [a for a in list(node._args) + list(node._kwargs.values())
+                if isinstance(a, (DAGNode, InputNode))]
+        if len(deps) != 1:
+            raise ValueError(
+                "CompiledActorDAG v1 supports linear chains: each actor "
+                f"node needs exactly one upstream, got {len(deps)}")
+        chain.append((node._handle, node._method_name))
+        node = deps[0]
+    if not isinstance(node, InputNode):
+        raise ValueError("the chain must start at an InputNode")
+    chain.reverse()
+    return chain
 
 
 def _topo(root: DAGNode):

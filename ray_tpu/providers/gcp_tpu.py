@@ -76,12 +76,15 @@ class _SliceHandle:
 
     _POLL_INTERVAL_S = 15.0
 
+    _FAILS_BEFORE_DEAD = 3  # consecutive GET failures before declaring
+
     def __init__(self, name: str, node_id: str, http: Any):
         self.name = name          # fully-qualified TPU node resource name
         self.rtpu_node_id = node_id  # identity the daemon registers under
         self._http = http
         self._last_poll = 0.0
         self._dead: Optional[str] = None
+        self._fails = 0
 
     def poll(self) -> Optional[str]:
         import time
@@ -93,9 +96,14 @@ class _SliceHandle:
         self._last_poll = now
         try:
             state = self._http.request("GET", self.name).get("state", "")
-        except Exception:  # noqa: BLE001 — 404 (deleted) or API error
-            self._dead = "GONE"
+        except Exception:  # noqa: BLE001 — could be 404 (deleted) OR a
+            # transient API hiccup: one blip must not orphan a live
+            # billing slice, so only consecutive failures count
+            self._fails += 1
+            if self._fails >= self._FAILS_BEFORE_DEAD:
+                self._dead = "GONE"
             return self._dead
+        self._fails = 0
         if state in ("CREATING", "STARTING", "READY", "RESTARTING",
                      "REPAIRING", ""):
             return None
@@ -161,12 +169,17 @@ class TpuVmNodeProvider(NodeProvider):
     @staticmethod
     def slice_node_type(accelerator_type: str,
                         cpus_per_host: float = 8.0) -> Dict[str, float]:
-        """The resource shape ONE slice adds to the cluster — what the
-        autoscaler bin-packs gang demand against. Mirrors
-        accelerators/tpu.py's per-host synthesis for worker 0."""
+        """The resource shape the slice's WORKER-0 daemon registers — what
+        the autoscaler bin-packs gang demand against. Chips are capped at
+        the per-host count (accelerators/tpu.py _chips_per_host): a
+        multi-host slice's other hosts register their own nodes, so
+        claiming the slice TOTAL here would admit task shapes worker-0
+        can never serve."""
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
         version, _, chips = accelerator_type.rpartition("-")
         version = {"v5litepod": "v5e"}.get(version, version)
-        n = float(chips)
         pod = f"{version}-{chips}"
+        per_host = TPUAcceleratorManager._chips_per_host(pod)
+        n = float(min(int(chips), per_host))
         return {"CPU": cpus_per_host, "TPU": n, f"TPU-{version}": n,
                 f"TPU-{pod}-head": 1.0}

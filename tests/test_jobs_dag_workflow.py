@@ -193,3 +193,66 @@ def test_compiled_dag_fuses_to_one_program(cluster_rt):
     # repeat executions reuse the compiled program (fast path exists)
     np.testing.assert_allclose(np.asarray(compiled.execute(x * 2)),
                                np.asarray(x * 2) * 2 * (np.asarray(x * 2) + 1))
+
+
+def test_compiled_actor_dag_pipeline(cluster_rt):
+    """Cross-actor compiled DAG: pre-launched loops + shm channel rings
+    (reference aDAG, compiled_dag_node.py:767). Correctness, error
+    propagation, and the VERDICT #7 done-criterion: steady-state
+    throughput >= 2x eager chained actor calls."""
+    import time
+
+    from ray_tpu.dag import InputNode, experimental_compile
+
+    @rt.remote(num_cpus=0)
+    class Doubler:
+        def f(self, x):
+            if x == "boom":
+                raise ValueError("boom-input")
+            return x * 2
+
+    @rt.remote(num_cpus=0)
+    class AddOne:
+        def g(self, x):
+            return x + 1
+
+    a, b = Doubler.remote(), AddOne.remote()
+    # warm the actors (placement + construction out of the measurement)
+    assert rt.get(b.g.remote(rt.get(a.f.remote(1)))) == 3
+
+    with InputNode() as inp:
+        dag = b.g.bind(a.f.bind(inp))
+    compiled = experimental_compile(dag)
+    try:
+        # correctness + ordering under pipelined submission
+        refs = [compiled.execute(i) for i in range(20)]
+        assert [r.get() for r in refs] == [2 * i + 1 for i in range(20)]
+
+        # error propagation: the exception travels the channel and the
+        # pipeline keeps working afterwards
+        bad = compiled.execute("boom")
+        ok = compiled.execute(5)
+        with pytest.raises(ValueError, match="boom-input"):
+            bad.get()
+        assert ok.get() == 11
+
+        # ---- A/B: eager chained calls vs the compiled pipeline ----
+        # (get-between is the FASTER eager form here — ref-arg chaining
+        # pays cross-actor object resolution — so it is the fair baseline)
+        N = 200
+        t0 = time.perf_counter()
+        for i in range(N):
+            rt.get(b.g.remote(rt.get(a.f.remote(i))))
+        eager_rate = N / (time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        refs = [compiled.execute(i) for i in range(N)]
+        out = [r.get() for r in refs]
+        compiled_rate = N / (time.perf_counter() - t0)
+        assert out[-1] == 2 * (N - 1) + 1
+        speedup = compiled_rate / eager_rate
+        print(f"eager {eager_rate:.0f}/s compiled {compiled_rate:.0f}/s "
+              f"speedup {speedup:.1f}x")
+        assert speedup >= 2.0, (eager_rate, compiled_rate)
+    finally:
+        compiled.teardown()
