@@ -9,6 +9,7 @@ store.
 """
 
 from ray_tpu.rllib.algorithm import PPO, PPOConfig
+from ray_tpu.rllib.bc import BC, BCConfig, BCLearner, record_dataset
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.impala import (IMPALA, IMPALAConfig, IMPALALearner,
                                   vtrace)
@@ -19,6 +20,7 @@ from ray_tpu.rllib.learner import PPOLearner, compute_gae
 from ray_tpu.rllib.module import forward, init_module, sample_actions
 
 __all__ = [
+    "BC", "BCConfig", "BCLearner", "record_dataset",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace",
     "PPO", "PPOConfig", "PPOLearner", "EnvRunner", "VectorEnv",

@@ -1,0 +1,149 @@
+"""BC — offline behavior cloning from a ray_tpu.data dataset.
+
+Role-equivalent to the reference's offline-RL stack (reference:
+rllib/algorithms/bc/bc.py + rllib/offline/offline_data.py: recorded
+episodes stream from a Dataset into the Learner). TPU-first shape: the
+learner is ONE jitted supervised update (cross-entropy of the policy head
+against recorded actions) through the same Learner seam the online
+algorithms use, and ingest is ray_tpu.data's iter_batches — proving the
+Data -> Train path end to end. Evaluation runs greedy EnvRunner actors.
+
+``record_dataset`` is the offline-writer half (reference:
+rllib/offline/offline_env_runner.py): roll a trained policy and persist
+(obs, action) rows as a Dataset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY
+from ray_tpu.rllib.module import forward, init_module
+from ray_tpu.rllib.trainer_base import TrainerBase
+
+
+class BCLearner:
+    """Jitted supervised update: -log pi(a_recorded | obs)."""
+
+    def __init__(self, *, lr: float = 1e-3, mesh=None):
+        import jax
+        import optax
+
+        self.optimizer = optax.adam(lr)
+        self.mesh = mesh
+        self.opt_state = None
+
+        def update_impl(params, opt_state, obs, actions):
+            def loss_fn(p):
+                logits, _ = forward(p, obs)
+                logp = jax.nn.log_softmax(logits)
+                nll = -logp[jax.numpy.arange(obs.shape[0]), actions]
+                return nll.mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update_impl)
+
+    def init(self, params) -> None:
+        self.opt_state = self.optimizer.init(params)
+
+    def update(self, params, batch: Dict[str, np.ndarray]):
+        params, self.opt_state, loss = self._update(
+            params, self.opt_state,
+            np.asarray(batch["obs"], np.float32),
+            np.asarray(batch["action"], np.int32))
+        return params, {"bc_loss": float(loss)}
+
+
+def record_dataset(algo, num_samples: int = 8192):
+    """Roll `algo`'s current policy through its own runners and persist
+    the visited (obs, action) pairs as a ray_tpu.data Dataset — the
+    offline-data writer (reference: offline_env_runner.py)."""
+    from ray_tpu.data import from_numpy
+
+    algo._broadcast_weights()
+    obs_parts, act_parts = [], []
+    total = 0
+    while total < num_samples:
+        batches = ray_tpu.get(
+            [r.sample.remote() for r in algo.runners], timeout=600)
+        for b in batches:
+            T, B = b["actions"].shape
+            obs_parts.append(
+                b["obs"].reshape(T * B, -1).astype(np.float32))
+            act_parts.append(b["actions"].reshape(T * B).astype(np.int32))
+            total += T * B
+    obs = np.concatenate(obs_parts)[:num_samples]
+    act = np.concatenate(act_parts)[:num_samples]
+    return from_numpy({"obs": obs, "action": act})
+
+
+@dataclasses.dataclass
+class BCConfig:
+    dataset: Any = None          # ray_tpu.data Dataset: {obs, action}
+    env: str = "CartPole-v1"     # evaluation environment
+    lr: float = 1e-3
+    batch_size: int = 512
+    num_eval_runners: int = 1
+    num_envs_per_runner: int = 16
+    eval_rollout_length: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self, mesh=None) -> "BC":
+        if self.dataset is None:
+            raise ValueError("BCConfig.dataset is required (use "
+                             "rllib.record_dataset to create one)")
+        return BC(self, mesh=mesh)
+
+
+class BC(TrainerBase):
+    """train() = one epoch over the dataset + one greedy evaluation."""
+
+    def __init__(self, config: BCConfig, mesh=None):
+        import jax
+        self.config = config
+        spec = ENV_REGISTRY[config.env](1)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._key, sub = jax.random.split(self._key)
+        self.params = init_module(sub, spec.observation_dim,
+                                  spec.num_actions, config.hidden)
+        self.learner = BCLearner(lr=config.lr, mesh=mesh)
+        self.learner.init(self.params)
+        # greedy evaluation runners (epsilon 0 => argmax over the policy
+        # head): offline training, ONLINE measurement
+        self._make_runners(config.env, config.num_eval_runners,
+                           config.num_envs_per_runner,
+                           config.eval_rollout_length, config.seed,
+                           exploration="epsilon_greedy")
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        losses = []
+        n = 0
+        for batch in self.config.dataset.iter_batches(
+                batch_size=self.config.batch_size, drop_last=True):
+            self.params, metrics = self.learner.update(self.params, batch)
+            losses.append(metrics["bc_loss"])
+            n += len(batch["action"])
+        # greedy eval episode returns
+        self._broadcast_weights(epsilon=0.0)
+        evals = ray_tpu.get([r.sample.remote() for r in self.runners],
+                            timeout=600)
+        returns = np.concatenate([b["episode_returns"] for b in evals])
+        self._track_returns(returns)
+        return self._base_result(
+            episodes=int(len(returns)), t0=t0,
+            env_steps_this_iter=n,
+            learner={"bc_loss": float(np.mean(losses)) if losses
+                     else float("nan")})
