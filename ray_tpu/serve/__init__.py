@@ -23,12 +23,15 @@ from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import multiplexed, get_multiplexed_model_id
+from ray_tpu.serve.schema import (DeploymentSchema, ServeApplicationSchema,
+                                  deploy_from_spec)
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "delete", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "start_http_proxy", "start_grpc_proxy", "batch",
     "multiplexed", "get_multiplexed_model_id",
+    "DeploymentSchema", "ServeApplicationSchema", "deploy_from_spec",
 ]
 
 

@@ -58,14 +58,52 @@ class ServeController:
     RECONCILE_PERIOD_S = 0.25
 
     def __init__(self):
+        import collections
         self._lock = threading.RLock()
         self._deployments: Dict[str, _DeploymentState] = {}
+        self._apps: Dict[str, list] = {}  # app name -> deployment names
         self._proxy = None
         self._proxy_port: Optional[int] = None
         self._stop = threading.Event()
+        # push-based routing (reference: serve LongPollHost,
+        # _private/long_poll.py:204): every routing-table version bump is
+        # published on the cluster pubsub broker; routers subscribe and
+        # refresh IMMEDIATELY instead of waiting out a staleness window.
+        # Events queue under the lock and publish off-thread (publishing
+        # is an RPC to the head).
+        self._route_events = collections.deque()
+        self._route_kick = threading.Event()
+        threading.Thread(target=self._route_publish_loop, daemon=True,
+                         name="serve-routes-pub").start()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
         self._thread.start()
+
+    # single definition lives in router.py (subscriber side)
+    from ray_tpu.serve.router import ROUTE_TOPIC as ROUTE_TOPIC
+
+    def _bump_version(self, st: "_DeploymentState") -> None:
+        """Routing table changed (call under self._lock): bump + queue a
+        push notification for subscribed routers."""
+        st.version = st.version + 1
+        self._route_events.append((st.name, st.version))
+        self._route_kick.set()
+
+    def _route_publish_loop(self) -> None:
+        from ray_tpu.util import pubsub
+        while not self._stop.is_set():
+            self._route_kick.wait(timeout=0.5)
+            self._route_kick.clear()
+            latest: Dict[str, int] = {}
+            while self._route_events:
+                name, v = self._route_events.popleft()
+                latest[name] = max(v, latest.get(name, -1))
+            for name, v in latest.items():
+                try:
+                    pubsub.publish(self.ROUTE_TOPIC,
+                                   {"deployment": name, "version": v})
+                except Exception:  # noqa: BLE001 — routers fall back to
+                    pass           # the lazy staleness refresh
 
     # ----------------------------------------------------------------- API
 
@@ -138,6 +176,15 @@ class ServeController:
                     "deleted": st.deleted,
                     "unhealthy_reason": st.unhealthy_reason,
                 } for name, st in self._deployments.items()}
+
+    def set_app(self, app: str, names: List[str]) -> List[str]:
+        """Record app membership; returns the deployments a previous
+        apply created that the new spec DROPPED (declarative diff —
+        the caller deletes them)."""
+        with self._lock:
+            before = set(self._apps.get(app, []))
+            self._apps[app] = list(names)
+            return sorted(before - set(names))
 
     def list_deployments(self) -> List[str]:
         with self._lock:
@@ -214,7 +261,7 @@ class ServeController:
         st.draining = []
         st.drain_deadline.clear()
         st.ready.clear()
-        st.version += 1
+        self._bump_version(st)
 
     def _start_replica(self, st: _DeploymentState):
         spec = st.spec
@@ -271,7 +318,7 @@ class ServeController:
                         stale = fresh
                     else:
                         st.replicas.extend(fresh)
-                        st.version += 1
+                        self._bump_version(st)
                         stale = []
                 for h in stale:
                     try:
@@ -286,7 +333,7 @@ class ServeController:
                     # their in-flight requests finish (_process_draining)
                     victims = st.replicas[delta:]
                     st.replicas = st.replicas[:delta]
-                    st.version += 1
+                    self._bump_version(st)
                     deadline = now + self.DRAIN_TIMEOUT_S
                     for h in victims:
                         st.draining.append(h)
@@ -355,7 +402,7 @@ class ServeController:
                            len(dead), st.name)
             with self._lock:
                 st.replicas = [h for h in st.replicas if h not in dead]
-                st.version += 1
+                self._bump_version(st)
                 st.consecutive_failures += len(dead)
                 if st.consecutive_failures >= self.MAX_CONSECUTIVE_FAILURES:
                     st.unhealthy_reason = (

@@ -16,10 +16,80 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve.multiplex import MUX_KWARG
+
+
+#: pubsub topic for routing-table pushes — controller publishes, routers
+#: subscribe (single definition; controller.py imports it)
+ROUTE_TOPIC = "serve:routes"
+
+
+class _RouteListener:
+    """Process-wide subscriber to the controller's routing pushes
+    (reference: serve LongPollClient over LongPollHost,
+    _private/long_poll.py:204): one pubsub long-poll thread fans table
+    invalidations out to every registered Router, so a replica death or
+    scale event reroutes immediately instead of after the staleness
+    window."""
+
+    _instance: Optional["_RouteListener"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._routers: list = []  # weakrefs
+
+    @classmethod
+    def register(cls, router: "Router") -> None:
+        with cls._lock:
+            inst = cls._instance
+            if inst is None:
+                inst = cls._instance = cls()
+                threading.Thread(target=inst._loop, daemon=True,
+                                 name="serve-route-listener").start()
+            inst._routers.append(weakref.ref(router))
+
+    def _loop(self) -> None:
+        from ray_tpu.util import pubsub
+        sub = None
+        while sub is None:
+            try:
+                sub = pubsub.Subscriber(ROUTE_TOPIC)
+            except Exception:  # noqa: BLE001 — broker not reachable yet
+                # (startup race): keep retrying — giving up would demote
+                # every router in this process to the 30s staleness
+                # fallback for the process lifetime
+                time.sleep(2.0)
+        while True:
+            try:
+                got = sub.get(timeout=5.0)
+            except Exception:  # noqa: BLE001 — broker hiccup
+                time.sleep(1.0)
+                continue
+            if got is None:
+                continue
+            _, msg = got
+            name = msg.get("deployment")
+            version = msg.get("version", -1)
+            with self._lock:
+                live = []
+                targets = []
+                for r in self._routers:
+                    router = r()
+                    if router is None:
+                        continue
+                    live.append(r)
+                    if router._name == name and router._version != version:
+                        targets.append(router)
+                self._routers = live
+            for router in targets:
+                try:
+                    router._refresh(force=True)
+                except Exception:  # noqa: BLE001 — next push/lazy refresh
+                    pass
 
 
 class DeploymentResponse:
@@ -60,21 +130,37 @@ class DeploymentResponseGenerator:
     value; the router's in-flight count for the replica is released once,
     when the stream ends (or this wrapper is dropped)."""
 
-    def __init__(self, ref_gen, on_done):
+    def __init__(self, ref_gen, on_done, retry=None):
         self._gen = ref_gen
         self._on_done = on_done
         self._done = False
+        self._retry = retry
+        self._yielded = False
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        from ray_tpu.exceptions import ActorError
         try:
             ref = next(self._gen)
+            value = ray_tpu.get(ref, timeout=300)
+        except ActorError:
+            # replica died BEFORE producing anything: safe to re-route
+            # (once items flowed, replaying could duplicate side effects)
+            if self._yielded or self._retry is None:
+                self._finish()
+                raise
+            self._finish()
+            fresh = self._retry()
+            self._gen, self._on_done = fresh._gen, fresh._on_done
+            self._done, self._retry = False, None
+            return next(self)
         except BaseException:
             self._finish()
             raise
-        return ray_tpu.get(ref, timeout=300)
+        self._yielded = True
+        return value
 
     def _finish(self) -> None:
         if not self._done:
@@ -89,7 +175,10 @@ class DeploymentResponseGenerator:
 
 
 class Router:
-    TABLE_MAX_AGE_S = 2.0
+    # FALLBACK staleness bound only: routing updates arrive by pubsub
+    # push (_RouteListener), so the lazy age check is a safety net for a
+    # broker outage, not the freshness mechanism
+    TABLE_MAX_AGE_S = 30.0
     # forget a model->replica affinity not re-confirmed within this window
     # (the replica has likely LRU-evicted the model by then anyway)
     MUX_AFFINITY_TTL_S = 120.0
@@ -110,6 +199,7 @@ class Router:
         # serve/multiplex.py module docstring): model_id -> {replica key
         # -> last routed-at timestamp}
         self._mux_affinity: Dict[str, Dict[str, float]] = {}
+        _RouteListener.register(self)
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -218,7 +308,13 @@ class Router:
         except BaseException:
             done()
             raise
-        return DeploymentResponseGenerator(gen, done)
+
+        def retry():
+            # pre-first-item replica death: refetch the table, re-route
+            self._refresh(force=True)
+            return self.route_streaming(method_name, args,
+                                        dict(kwargs), model_id)
+        return DeploymentResponseGenerator(gen, done, retry=retry)
 
     def route(self, method_name: str, args: tuple, kwargs: dict,
               model_id: str = "") -> DeploymentResponse:

@@ -249,3 +249,114 @@ def test_delete_deployment(serve_rt):
             return
         time.sleep(0.2)
     pytest.fail("deployment was not removed")
+
+
+def test_deploy_from_spec_declarative(serve_rt, tmp_path):
+    """Dict/YAML app specs deploy + reconcile declaratively (VERDICT #9;
+    reference: serve/schema.py + build_app + `serve deploy`)."""
+    import sys
+
+    mod = tmp_path / "specmod_app.py"
+    mod.write_text(
+        "class Echo:\n"
+        "    def __init__(self, prefix):\n"
+        "        self.prefix = prefix\n"
+        "    def __call__(self, x):\n"
+        "        return f'{self.prefix}:{x}'\n"
+        "\n"
+        "def shout(x):\n"
+        "    return str(x).upper()\n")
+    sys.path.insert(0, str(tmp_path))
+    import cloudpickle
+    import importlib
+    specmod = importlib.import_module("specmod_app")
+    # replicas cannot import the tmp module by name: ship it by value
+    # (the standard technique for code outside the cluster's sys.path)
+    cloudpickle.register_pickle_by_value(specmod)
+    try:
+        spec = {
+            "name": "app1",
+            "deployments": [
+                {"name": "echo", "import_path": "specmod_app:Echo",
+                 "init_args": ["hi"], "num_replicas": 1},
+                {"name": "shout", "import_path": "specmod_app:shout"},
+            ],
+        }
+        status = serve.deploy_from_spec(spec)
+        assert status["echo"]["ready_replicas"] >= 1
+        assert serve.get_app_handle("echo").remote("x").result() == "hi:x"
+        assert serve.get_app_handle("shout").remote("ab").result() == "AB"
+
+        # YAML form + declarative diff: dropping 'shout' deletes it
+        yaml_spec = (
+            "name: app1\n"
+            "deployments:\n"
+            "  - name: echo\n"
+            "    import_path: specmod_app:Echo\n"
+            "    init_args: [hello]\n"
+            "    num_replicas: 1\n")
+        serve.deploy_from_spec(yaml_spec)
+        assert serve.get_app_handle("echo").remote("y").result() == "hello:y"
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if "shout" not in serve.status() \
+                    or serve.status()["shout"]["deleted"]:
+                break
+            time.sleep(0.2)
+        st = serve.status()
+        assert "shout" not in st or st["shout"]["deleted"]
+
+        with pytest.raises(ValueError, match="unknown deployment fields"):
+            serve.deploy_from_spec({"deployments": [
+                {"name": "x", "import_path": "specmod_app:Echo",
+                 "bogus": 1}]})
+    finally:
+        sys.path.remove(str(tmp_path))
+        serve.delete("echo")
+
+
+def test_push_rerouting_on_replica_death(serve_rt):
+    """Replica death reroutes via the pubsub PUSH (VERDICT #9): the
+    router learns the new table in ~health-check time, far under the 30s
+    lazy-staleness fallback window."""
+    import ray_tpu
+
+    @serve.deployment(num_replicas=2)
+    def pong(x):
+        return x + 1
+
+    handle = serve.run(pong)
+    assert handle.remote(1).result() == 2
+    router = handle._router
+    # force a fresh table so the router is demonstrably NOT stale now
+    router._refresh(force=True)
+    v0 = router._version
+    assert len(router._replicas) == 2
+
+    victim = router._replicas[0]
+    ray_tpu.kill(victim)
+    # the controller detects the death (health loop), bumps the version,
+    # and PUSHES: the router's table must update well before the 30s
+    # fallback could possibly fire
+    t0 = time.monotonic()
+    deadline = t0 + 15
+    while time.monotonic() < deadline:
+        if router._version != v0 and len(router._replicas) >= 1 \
+                and all(h.actor_id != victim.actor_id
+                        for h in router._replicas):
+            break
+        time.sleep(0.1)
+    push_latency = time.monotonic() - t0
+    assert router._version != v0, "router never saw the push"
+    assert push_latency < Router_TABLE_MAX_AGE_GUARD, \
+        f"table updated only after {push_latency:.1f}s (staleness window?)"
+    # requests keep flowing on the survivor (and on the replacement)
+    for i in range(5):
+        assert handle.remote(i).result() == i + 1
+    serve.delete("pong")
+
+
+# the push must beat the fallback with wide margin; half the fallback
+# window is a conservative ceiling even on a loaded CI host
+from ray_tpu.serve.router import Router as _Router  # noqa: E402
+Router_TABLE_MAX_AGE_GUARD = _Router.TABLE_MAX_AGE_S / 2
