@@ -11,6 +11,7 @@ resources; on TPU clusters a trial's resources are a slice-shaped gang
 from typing import Any, Dict
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
                                      PopulationBasedTraining, TrialScheduler)
 from ray_tpu.tune.searcher import (BasicVariantSearcher,
                                    HyperOptLikeSearcher, Searcher)
@@ -22,6 +23,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, TuneRunConfig, Tuner
 __all__ = [
     "Tuner", "TuneConfig", "TuneRunConfig", "ResultGrid", "Trial",
     "TrialStatus", "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining", "uniform", "loguniform", "randint", "choice",
     "sample_from", "grid_search", "report", "get_checkpoint",
     "Searcher", "BasicVariantSearcher", "HyperOptLikeSearcher",

@@ -168,3 +168,50 @@ class PopulationBasedTraining(TrialScheduler):
                 if fresh is not None:
                     config[key] = fresh
         return config
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Median stopping (reference: tune/schedulers/median_stopping_rule.py,
+    the Vizier rule): a trial stops at step t when its RUNNING-AVERAGE
+    result is worse than the median of the other trials' running averages
+    at the same step — a distribution-free early-stopping rule that
+    complements ASHA (quantile-per-rung) with a per-step median gate.
+
+    ``grace_period`` steps always run; the rule activates once
+    ``min_samples_required`` other trials have reported at step t.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 hard_stop: bool = True):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.hard_stop = hard_stop
+        # trial_id -> (sum, count) of scores; and per-step running-average
+        # snapshots: step -> {trial_id: running_avg}
+        self._sums: Dict[str, List[float]] = {}
+        self._at_step: Dict[int, Dict[str, float]] = defaultdict(dict)
+
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  all_trials: List[Trial]) -> str:
+        s = self.score(result)
+        if s is None:
+            return Decision.CONTINUE
+        t = int(result.get(self.time_attr, 0))
+        acc = self._sums.setdefault(trial.trial_id, [0.0, 0])
+        acc[0] += s
+        acc[1] += 1
+        running = acc[0] / acc[1]
+        self._at_step[t][trial.trial_id] = running
+        if t <= self.grace_period:
+            return Decision.CONTINUE
+        others = [v for tid, v in self._at_step[t].items()
+                  if tid != trial.trial_id]
+        if len(others) < self.min_samples:
+            return Decision.CONTINUE
+        ordered = sorted(others)
+        median = ordered[len(ordered) // 2]
+        if running < median:
+            return Decision.STOP if self.hard_stop else Decision.CONTINUE
+        return Decision.CONTINUE

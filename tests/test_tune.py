@@ -239,3 +239,31 @@ def test_sequential_searcher_feedback_improves(local_rt):
     first_wave = [abs(c["x"] - 2.0) for _, c in searcher._observed[:6]]
     last_wave = [abs(c["x"] - 2.0) for _, c in searcher._observed[-6:]]
     assert sum(last_wave) / 6 <= sum(first_wave) / 6 + 0.5
+
+
+def test_median_stopping_rule_prunes_below_median(local_rt):
+    MAX_T = 24
+
+    def trainable(cfg):
+        for i in range(MAX_T):
+            tune.report({"score": cfg["slope"] * (i + 1)})
+
+    # strong trials first so the per-step median is already meaningful
+    # when the weak trials arrive (same rationale as the ASHA test)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"slope": tune.grid_search(
+            [4.0, 3.0, 2.0, 1.0, 0.4, 0.3, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.MedianStoppingRule(
+                grace_period=2, min_samples_required=2),
+            max_concurrent_trials=2))
+    grid = tuner.fit()
+    iters = {t.config["slope"]: t.iteration for t in grid.trials}
+    total = sum(iters.values())
+    assert total < 8 * MAX_T * 0.8, f"median rule saved no work: {iters}"
+    # the best trial must run to completion; the worst must stop early
+    assert iters[4.0] >= MAX_T - 1, iters
+    assert iters[0.1] < MAX_T, iters
+    assert grid.get_best_result().config["slope"] == 4.0
