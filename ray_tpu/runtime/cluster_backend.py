@@ -399,25 +399,37 @@ class _ActorSubmitter:
         self.lock = threading.Lock()
         self.resolving = False
         self._flushing = False
+        self._last_submit = 0.0
         self.creation_pins = creation_pins or []
         if self.creation_pins:
             self._ensure_resolver()
 
     def submit(self, payload: dict, spec: TaskSpec, pins: list) -> None:
         t = _PendingTask(payload, spec, pins)
+        now = time.monotonic()
         with self.lock:
             if self.state == "DEAD":
                 dead = True
+                bursting = False
             else:
                 dead = False
                 self.pending.append(t)
+                # burst detection (same idea as the transport's write
+                # coalescing): back-to-back submits defer to the shared
+                # flusher thread, which drains them as ONE batched frame;
+                # isolated submits flush inline for latency
+                bursting = now - self._last_submit < 0.0002
+                self._last_submit = now
         if dead:
             self.backend._store_task_error(
                 spec, ActorDiedError(self.actor_id.hex(), self.dead_reason),
                 pins)
             return
         if self.state == "ALIVE":
-            self._flush()
+            if bursting:
+                self.backend._defer_actor_flush(self)
+            else:
+                self._flush()
         else:
             self._ensure_resolver()
 
@@ -506,12 +518,28 @@ class _ActorSubmitter:
                         addr = self.address
                     for t in tasks:
                         t.attempts += 1
-                    client = self.backend.peers.get(addr)
-                    # one frame for the whole run of queued calls; the
-                    # actor executes them in seq order either way
-                    client.call_batch_cb(
-                        "push_task", [t.payload for t in tasks],
-                        lambda i, v, e, ts=tasks: self._on_reply(ts[i], v, e))
+                    try:
+                        client = self.backend.peers.get(addr)
+                        # one frame for the whole run of queued calls; the
+                        # actor executes them in seq order either way
+                        client.call_batch_cb(
+                            "push_task", [t.payload for t in tasks],
+                            lambda i, v, e, ts=tasks:
+                                self._on_reply(ts[i], v, e))
+                    except BaseException:
+                        # synchronous submit failure (stale address etc):
+                        # popped tasks must NOT vanish — requeue in order
+                        # and re-resolve. Critical on the deferred-flush
+                        # path, where no caller would see the raise.
+                        for t in tasks:
+                            t.attempts -= 1
+                            self._requeue_ordered(t)
+                        with self.lock:
+                            self.address = None
+                            if self.state == "ALIVE":
+                                self.state = "RESOLVING"
+                        self._ensure_resolver()
+                        break
             finally:
                 with self.lock:
                     self._flushing = False
@@ -653,6 +681,19 @@ class ClusterBackend:
                                         name="lease-reaper")
         self._reaper.start()
 
+        # shared actor-submit flusher: bursting submitters defer here so
+        # a tight .remote() loop coalesces into batched frames (the GIL
+        # timeslice between the submitting thread and this one sets the
+        # natural batch size). Dedicated lock: this is the hottest submit
+        # path — it must not contend on the backend-wide _lock.
+        self._aflush_subs: set = set()
+        self._aflush_lock = threading.Lock()
+        self._aflush_wake = threading.Event()
+        self._aflush_thread = threading.Thread(
+            target=self._actor_flush_loop, daemon=True,
+            name=f"{role}-aflush")
+        self._aflush_thread.start()
+
         # telemetry: metric snapshots + task-event spans → head
         # (reference: metrics agent push + TaskEventBuffer→GcsTaskManager)
         from ray_tpu.runtime.events import TaskEventBuffer
@@ -661,6 +702,26 @@ class ClusterBackend:
                                            daemon=True,
                                            name=f"{role}-telemetry")
         self._telemetry.start()
+
+    def _defer_actor_flush(self, sub: "_ActorSubmitter") -> None:
+        with self._aflush_lock:
+            self._aflush_subs.add(sub)
+        self._aflush_wake.set()
+
+    def _actor_flush_loop(self) -> None:
+        while not self._closed:
+            self._aflush_wake.wait(timeout=0.5)
+            self._aflush_wake.clear()
+            self._drain_actor_flushes()
+
+    def _drain_actor_flushes(self) -> None:
+        with self._aflush_lock:
+            subs, self._aflush_subs = self._aflush_subs, set()
+        for sub in subs:
+            try:
+                sub._flush()
+            except Exception:  # noqa: BLE001 — _flush requeues its tasks
+                pass           # and re-resolves on submit failures
 
     def _telemetry_loop(self) -> None:
         from ray_tpu.core.config import GlobalConfig
@@ -1230,6 +1291,11 @@ class ClusterBackend:
         self._borrow_wake.set()
         self._borrow_thread.join(timeout=2.0)
         self.flush_borrows()     # queued unborrows must reach owners
+        # burst-deferred actor submits must hit the wire before teardown
+        # closes the peers (the flush loop exits on _closed)
+        self._aflush_wake.set()
+        self._aflush_thread.join(timeout=2.0)
+        self._drain_actor_flushes()
         with self._lock:
             subs = list(self._submitters.values())
         for sub in subs:

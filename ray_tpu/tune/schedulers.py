@@ -182,12 +182,10 @@ class MedianStoppingRule(TrialScheduler):
     """
 
     def __init__(self, *, time_attr: str = "training_iteration",
-                 grace_period: int = 1, min_samples_required: int = 3,
-                 hard_stop: bool = True):
+                 grace_period: int = 1, min_samples_required: int = 3):
         self.time_attr = time_attr
         self.grace_period = grace_period
         self.min_samples = min_samples_required
-        self.hard_stop = hard_stop
         # trial_id -> (sum, count) of scores; and per-step running-average
         # snapshots: step -> {trial_id: running_avg}
         self._sums: Dict[str, List[float]] = {}
@@ -211,7 +209,11 @@ class MedianStoppingRule(TrialScheduler):
         if len(others) < self.min_samples:
             return Decision.CONTINUE
         ordered = sorted(others)
-        median = ordered[len(ordered) // 2]
+        mid = len(ordered) // 2
+        # true median: even counts average the middle pair (taking the
+        # upper-middle would stop trials that beat the real median)
+        median = ordered[mid] if len(ordered) % 2 \
+            else (ordered[mid - 1] + ordered[mid]) / 2.0
         if running < median:
-            return Decision.STOP if self.hard_stop else Decision.CONTINUE
+            return Decision.STOP
         return Decision.CONTINUE
