@@ -283,6 +283,16 @@ class _TaskSubmitter:
 
     def _on_push_failed(self, state: _BatchState) -> None:
         self._drop_lease(state.lease)
+        # the worker behind this ADDRESS is gone: every cached lease on it
+        # is a corpse too — retrying onto one would burn the whole retry
+        # budget in microseconds (native transport fails dead-addr pushes
+        # instantly)
+        dead_addr = state.lease.worker_addr
+        with self.lock:
+            stale = [l for l in self.leases.values()
+                     if l.worker_addr == dead_addr]
+        for l in stale:
+            self._drop_lease(l)
         retry = []
         for task, exc in state.failed:
             if isinstance(exc, RpcError) and \
@@ -526,14 +536,25 @@ class _ActorSubmitter:
                             "push_task", [t.payload for t in tasks],
                             lambda i, v, e, ts=tasks:
                                 self._on_reply(ts[i], v, e))
-                    except BaseException:
-                        # synchronous submit failure (stale address etc):
+                    except Exception as e:  # noqa: BLE001
+                        # Synchronous submit failure (stale address etc):
                         # popped tasks must NOT vanish — requeue in order
-                        # and re-resolve. Critical on the deferred-flush
-                        # path, where no caller would see the raise.
+                        # and re-resolve (critical on the deferred-flush
+                        # path, where no caller would see the raise). The
+                        # attempt COUNTS: a deterministic failure (actor
+                        # reported ALIVE at an unreachable address) must
+                        # exhaust the retry budget, not loop forever.
                         for t in tasks:
-                            t.attempts -= 1
-                            self._requeue_ordered(t)
+                            if t.attempts <= t.spec.max_retries:
+                                self._requeue_ordered(t)
+                            else:
+                                self.backend._store_task_error(
+                                    t.spec,
+                                    ActorDiedError(
+                                        self.actor_id.hex(),
+                                        f"submit to {addr} kept failing: "
+                                        f"{e!r}"),
+                                    t.pins)
                         with self.lock:
                             self.address = None
                             if self.state == "ALIVE":
@@ -704,6 +725,15 @@ class ClusterBackend:
         self._telemetry.start()
 
     def _defer_actor_flush(self, sub: "_ActorSubmitter") -> None:
+        from ray_tpu.runtime.protocol import NATIVE_TRANSPORT
+        if not NATIVE_TRANSPORT:
+            # the pure-Python client connects SYNCHRONOUSLY inside the
+            # flush; one unreachable actor on the shared flusher thread
+            # would head-of-line-block every other bursting actor for a
+            # full connect timeout. The native transport connects
+            # asynchronously, so only it gets the shared-thread deferral.
+            sub._flush()
+            return
         with self._aflush_lock:
             self._aflush_subs.add(sub)
         self._aflush_wake.set()

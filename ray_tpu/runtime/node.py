@@ -30,6 +30,23 @@ from ray_tpu.core.ids import NodeID, WorkerID
 from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
 
 
+def _proc_dead(proc) -> bool:
+    """True when the child is dead, including dead-but-unreaped: Popen
+    poll() returns None while another thread (our per-worker waitpid
+    thread) holds the internal wait lock, so zombies need the /proc
+    state check."""
+    if proc.poll() is not None:
+        return True
+    try:
+        with open(f"/proc/{proc.pid}/stat") as f:
+            # field 3 is the state letter; comm (field 2) may contain
+            # spaces but is parenthesized — split after the last ')'
+            state = f.read().rsplit(")", 1)[1].split()[0]
+        return state in ("Z", "X", "x")
+    except (OSError, IndexError):
+        return True  # no /proc entry: reaped and gone
+
+
 class _WorkerEntry:
     __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id",
                  "chips", "env_key", "idle_since")
@@ -443,6 +460,16 @@ class NodeDaemon:
                 wid = pool.pop(0)
                 entry = self._workers.get(wid)
                 if entry is not None and entry.state == "idle":
+                    # Liveness gate: a worker that died while pooled must
+                    # never be handed out — the native transport fails
+                    # pushes to a corpse in microseconds, so re-leasing
+                    # one can burn a task's whole retry budget before the
+                    # waitpid loop reports the death. NOTE: poll() alone
+                    # can read None for a dead-but-unreaped child (the
+                    # _wait_worker thread holds the waitpid lock), hence
+                    # the /proc zombie check.
+                    if _proc_dead(entry.proc):
+                        continue  # the waitpid loop reports the death
                     entry.state = "leased"
                     return {"worker_id": wid, "worker_addr": entry.address}
             # count in-flight spawns too — concurrent lease RPCs must not
@@ -547,6 +574,10 @@ class NodeDaemon:
         with self._lock:
             entry = self._workers.get(p["worker_id"])
             if entry is None or entry.state == "dead":
+                return False
+            if _proc_dead(entry.proc):
+                # returned a corpse (the usual reason a lease comes back
+                # early): don't pool it — the waitpid loop reports it
                 return False
             if entry.chips is not None:
                 # chip workers are single-use: their TPU runtime already

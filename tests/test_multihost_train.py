@@ -169,6 +169,9 @@ def test_elastic_shrink_on_worker_loss(cluster_rt, tmp_path):
         scaling_config=train.ScalingConfig(
             num_workers=4,
             min_workers=2,
+            grow_poll_s=3600,  # this test asserts the SHRINK outcome; on
+            # a slow host the killed worker's freed CPU would otherwise
+            # trigger the (correct!) grow-back mid-test
             mesh=MeshSpec(dp=-1),
             jax_distributed=True,
             jax_platform="cpu",
