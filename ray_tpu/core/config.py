@@ -40,6 +40,7 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "lease_idle_linger_s": (float, 0.5, "idle lease kept this long for reuse before release"),
     "max_pending_lease_requests": (int, 10, "lease requests in flight per resource shape (reference: max_pending_lease_requests_per_scheduling_category)"),
     "task_push_batch": (int, 32, "max tasks coalesced into one push frame per lease/actor"),
+    "task_burst_defer": (bool, True, "defer bursty normal-task submits to the shared flusher (batch coalescing)"),
     "worker_pool_prestart": (int, 0, "workers prestarted per node"),
     "worker_pool_max": (int, 64, "max workers per node"),
     "worker_idle_timeout_s": (float, 300.0, "idle worker reap time"),

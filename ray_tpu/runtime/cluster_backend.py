@@ -104,12 +104,28 @@ class _TaskSubmitter:
         self.requesting = 0
         self._infeasible_since: Optional[float] = None
         self.lock = threading.Lock()
+        self._last_submit = 0.0
 
     # -- public --
 
     def submit(self, payload: dict, spec: TaskSpec, pins: list) -> None:
+        now = time.monotonic()
         with self.lock:
             self.pending.append(_PendingTask(payload, spec, pins))
+            # burst deferral (same as the actor submitter): back-to-back
+            # submits let pending ACCUMULATE for the shared flusher, whose
+            # _pump then ships proportional batches; isolated submits pump
+            # inline for latency
+            bursting = now - self._last_submit < 0.0002 \
+                and config_mod.GlobalConfig.task_burst_defer
+            self._last_submit = now
+        if bursting:
+            self.backend._defer_actor_flush(self)
+        else:
+            self._pump()
+
+    # flusher-thread entry (shared with _ActorSubmitter deferrals)
+    def _flush(self) -> None:
         self._pump()
 
     def cancel(self, task_id: bytes) -> bool:
@@ -536,7 +552,7 @@ class _ActorSubmitter:
                             "push_task", [t.payload for t in tasks],
                             lambda i, v, e, ts=tasks:
                                 self._on_reply(ts[i], v, e))
-                    except Exception as e:  # noqa: BLE001
+                    except BaseException as e:  # noqa: BLE001
                         # Synchronous submit failure (stale address etc):
                         # popped tasks must NOT vanish — requeue in order
                         # and re-resolve (critical on the deferred-flush
@@ -544,6 +560,9 @@ class _ActorSubmitter:
                         # attempt COUNTS: a deterministic failure (actor
                         # reported ALIVE at an unreachable address) must
                         # exhaust the retry budget, not loop forever.
+                        # KeyboardInterrupt/SystemExit re-raise AFTER the
+                        # requeue below, so an interrupted inline flush
+                        # still leaves every task accounted for.
                         for t in tasks:
                             if t.attempts <= t.spec.max_retries:
                                 self._requeue_ordered(t)
@@ -560,6 +579,8 @@ class _ActorSubmitter:
                             if self.state == "ALIVE":
                                 self.state = "RESOLVING"
                         self._ensure_resolver()
+                        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                            raise
                         break
             finally:
                 with self.lock:
@@ -707,6 +728,8 @@ class ClusterBackend:
         # timeslice between the submitting thread and this one sets the
         # natural batch size). Dedicated lock: this is the hottest submit
         # path — it must not contend on the backend-wide _lock.
+        from ray_tpu.runtime.protocol import NATIVE_TRANSPORT
+        self._native_transport = NATIVE_TRANSPORT  # fixed at process start
         self._aflush_subs: set = set()
         self._aflush_lock = threading.Lock()
         self._aflush_wake = threading.Event()
@@ -724,9 +747,8 @@ class ClusterBackend:
                                            name=f"{role}-telemetry")
         self._telemetry.start()
 
-    def _defer_actor_flush(self, sub: "_ActorSubmitter") -> None:
-        from ray_tpu.runtime.protocol import NATIVE_TRANSPORT
-        if not NATIVE_TRANSPORT:
+    def _defer_actor_flush(self, sub) -> None:
+        if not self._native_transport:
             # the pure-Python client connects SYNCHRONOUSLY inside the
             # flush; one unreachable actor on the shared flusher thread
             # would head-of-line-block every other bursting actor for a
