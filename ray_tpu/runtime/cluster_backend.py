@@ -848,6 +848,7 @@ class ClusterBackend:
 
     def _fast_retry(self, op: int, key: bytes, val: bytes = b"",
                     flags: int = 0) -> tuple:
+        from ray_tpu.runtime.protocol import FastPathUnavailable
         cfg = config_mod.GlobalConfig
         attempts = max(1, cfg.rpc_retry_max_attempts)
         delay = cfg.rpc_retry_base_ms / 1000.0
@@ -855,6 +856,13 @@ class ClusterBackend:
         for i in range(attempts):
             try:
                 return self.head.call_fast(op, key, val, flags=flags)
+            except FastPathUnavailable:
+                # the head answered via its Python path (restarted without
+                # the fastpath): deterministic — retrying the fast frame
+                # would burn the whole backoff budget on EVERY kv call.
+                # Demote this backend to the pickle path for good.
+                self._head_fast = False
+                raise
             except RpcError as e:
                 last = e
                 if i + 1 < attempts:  # no pointless sleep before the raise

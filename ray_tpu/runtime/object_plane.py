@@ -77,6 +77,12 @@ class ObjectPlane:
         self._lock = threading.Lock()
         # containment pins: owned object -> refs it contains (release on free)
         self._contained: Dict[ObjectID, list] = {}
+        # shared late-delete queue: failed/unroutable delete_object sends
+        # coalesce here and ONE drainer retries them after ONE node
+        # refresh — a per-failure thread would storm the head exactly
+        # when a node dies with many pinned objects on it
+        self._late_deletes: list = []   # (node_id, key)
+        self._late_thread_live = False
 
     # ------------------------------------------------------------- directory
 
@@ -577,27 +583,16 @@ class ObjectPlane:
         # pinned primary copies until the arena fills.
         targets = ([node_id] if node_id is not None else []) \
             + list(secondaries)
-        unknown = []
+        retry = []  # unknown-addr nodes AND definite send failures: a
+        # dropped delete leaks the pinned primary in the node's shm arena
+        # until restart, so both get one background retry after a refresh.
         for n in targets:
             addr = self.node_addrs.get(n)
-            if addr is not None:
-                self._peers.get(addr).oneway("delete_object",
-                                             {"object_id": key})
-            else:
-                unknown.append(n)
-        if unknown:
-            def _late_delete():
-                try:
-                    self.refresh_nodes()
-                except Exception:  # noqa: BLE001 — head gone: give up
-                    return
-                for n in unknown:
-                    addr = self.node_addrs.get(n)
-                    if addr is not None:
-                        self._peers.get(addr).oneway(
-                            "delete_object", {"object_id": key})
-            threading.Thread(target=_late_delete, daemon=True,
-                             name="late-delete").start()
+            if addr is None or not self._peers.get(addr).oneway(
+                    "delete_object", {"object_id": key}):
+                retry.append(n)
+        if retry:
+            self._queue_late_deletes(key, retry)
         with self._lock:
             contained = self._contained.pop(object_id, [])
         me = self.worker.worker_id.binary()
@@ -611,6 +606,39 @@ class ObjectPlane:
                     {"object_id": r.id().binary(), "borrower": me})
             except (RpcError, ObjectLostError):
                 pass
+
+    def _queue_late_deletes(self, key: bytes, nodes: list) -> None:
+        with self._lock:
+            self._late_deletes.extend((n, key) for n in nodes)
+            if self._late_thread_live:
+                return
+            self._late_thread_live = True
+        threading.Thread(target=self._drain_late_deletes, daemon=True,
+                         name="late-delete").start()
+
+    def _drain_late_deletes(self) -> None:
+        try:
+            while True:
+                time.sleep(0.2)  # coalesce a burst into one refresh
+                with self._lock:
+                    batch, self._late_deletes = self._late_deletes, []
+                    if not batch:
+                        self._late_thread_live = False
+                        return
+                try:
+                    self.refresh_nodes()
+                except Exception:  # noqa: BLE001 — head gone: give up
+                    with self._lock:
+                        self._late_thread_live = False
+                    return
+                for n, key in batch:
+                    addr = self.node_addrs.get(n)
+                    if addr is not None:
+                        self._peers.get(addr).oneway(
+                            "delete_object", {"object_id": key})
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            with self._lock:
+                self._late_thread_live = False
 
     def release_local_pin(self, object_id: ObjectID) -> None:
         """Borrow-release hook. Read pins are tied to view lifetime by the

@@ -41,6 +41,12 @@ class ChaosInjectedError(RpcError):
     pass
 
 
+class FastPathUnavailable(RpcError):
+    """The peer answered a binary fast frame via its Python path — the
+    fast path is deterministically absent there; callers should drop to
+    the pickle path immediately instead of retrying the fast frame."""
+
+
 def _chaos_table() -> Dict[str, int]:
     raw = config_mod.GlobalConfig.testing_rpc_failure
     table: Dict[str, int] = {}
@@ -503,16 +509,21 @@ class RpcClient:
                     delay = min(delay * 2, 5.0)
         raise last  # type: ignore[misc]
 
-    def oneway(self, method: str, payload: Any = None) -> None:
-        """Fire-and-forget (no reply frame will come back)."""
+    def oneway(self, method: str, payload: Any = None) -> bool:
+        """Fire-and-forget (no reply frame will come back).
+
+        Returns True if the frame was handed to the transport — a False
+        means the send definitely failed, so callers with cleanup-critical
+        oneways (object deletes) can queue a retry."""
         if _chaos.should_fail(method):
-            return
+            return True
         try:
             sock = self._connect()
             data = pickle.dumps((method, payload), protocol=5)
             _send_frame(sock, 0, data, self._wlock)
+            return True
         except BaseException:  # noqa: BLE001
-            pass
+            return False
 
     def close(self) -> None:
         self._closed = True

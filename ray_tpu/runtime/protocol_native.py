@@ -557,9 +557,23 @@ class RpcClient:
         if entry is None:
             return
         if fast:
-            # binary fast-path reply: (status, value bytes)
-            status, vlen = _FAST_REP.unpack_from(payload)
-            self._complete(entry, (status, payload[9:9 + vlen]), None)
+            # binary fast-path reply: (status, value bytes). A peer that
+            # answered via the Python path instead (conn accepted before
+            # rt_fastpath_enable, or head restarted without the fastpath)
+            # sends a pickled tuple here — its first byte (0x80) is not a
+            # valid status, so validate the frame shape and surface a
+            # transport error rather than returning garbage as a KV miss.
+            ok = len(payload) >= _FAST_REP.size
+            if ok:
+                status, vlen = _FAST_REP.unpack_from(payload)
+                ok = status in (0, 1) and vlen == len(payload) - _FAST_REP.size
+            if not ok:
+                from ray_tpu.runtime.protocol import FastPathUnavailable
+                self._complete(entry, None, FastPathUnavailable(
+                    "fast-path reply malformed (peer likely served the "
+                    "Python path); use the pickle path"))
+                return
+            self._complete(entry, (status, payload[_FAST_REP.size:]), None)
             return
         try:
             value, error = pickle.loads(payload)
@@ -710,16 +724,19 @@ class RpcClient:
                     delay = min(delay * 2, 5.0)
         raise last  # type: ignore[misc]
 
-    def oneway(self, method: str, payload: Any = None) -> None:
+    def oneway(self, method: str, payload: Any = None) -> bool:
+        """Fire-and-forget. Returns True if the frame was handed to the
+        transport (rt_send accepted it); False on a definite send failure
+        so cleanup-critical callers (object deletes) can retry."""
         from ray_tpu.runtime.protocol import _chaos_should_fail
         if _chaos_should_fail(method):
-            return
+            return True
         try:
             conn = self._connect()
             data = pickle.dumps((method, payload), protocol=5)
-            self._send(conn, 0, data)
+            return self._send(conn, 0, data)
         except BaseException:  # noqa: BLE001
-            pass
+            return False
 
     def close(self) -> None:
         from ray_tpu.runtime.protocol import RpcError

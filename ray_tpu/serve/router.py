@@ -52,21 +52,55 @@ class _RouteListener:
                                  name="serve-route-listener").start()
             inst._routers.append(weakref.ref(router))
 
+    #: consecutive get() failures before the subscriber is rebuilt — a
+    #: cluster shutdown + re-init in one process leaves the old subscriber
+    #: bound to the dead broker forever; rebuilding rebinds to whatever
+    #: head the CURRENT session points at instead of silently degrading
+    #: every router to the TABLE_MAX_AGE_S staleness fallback.
+    RESUBSCRIBE_AFTER = 3
+
+    def _refresh_all(self) -> None:
+        with self._lock:
+            routers = [r() for r in self._routers]
+        for router in routers:
+            if router is None:
+                continue
+            try:
+                router._refresh(force=True)
+            except Exception:  # noqa: BLE001 — next push/lazy refresh
+                pass
+
     def _loop(self) -> None:
         from ray_tpu.util import pubsub
         sub = None
-        while sub is None:
-            try:
-                sub = pubsub.Subscriber(ROUTE_TOPIC)
-            except Exception:  # noqa: BLE001 — broker not reachable yet
-                # (startup race): keep retrying — giving up would demote
-                # every router in this process to the 30s staleness
-                # fallback for the process lifetime
-                time.sleep(2.0)
+        failures = 0
+        resubscribed = False
         while True:
+            if sub is None:
+                try:
+                    sub = pubsub.Subscriber(ROUTE_TOPIC)
+                    failures = 0
+                except Exception:  # noqa: BLE001 — broker not reachable
+                    # yet (startup race) or session torn down: keep
+                    # retrying — giving up would demote every router in
+                    # this process to the staleness fallback for the
+                    # process lifetime
+                    time.sleep(2.0)
+                    continue
+                if resubscribed:
+                    # pushes published during the outage are gone (a
+                    # fresh subscriber starts at the topic head): force
+                    # every live router to re-pull its table now
+                    resubscribed = False
+                    self._refresh_all()
             try:
                 got = sub.get(timeout=5.0)
-            except Exception:  # noqa: BLE001 — broker hiccup
+                failures = 0
+            except Exception:  # noqa: BLE001 — broker hiccup or dead
+                failures += 1
+                if failures >= self.RESUBSCRIBE_AFTER:
+                    sub = None  # rebuild: re-reads epoch + topic heads
+                    resubscribed = True
                 time.sleep(1.0)
                 continue
             if got is None:

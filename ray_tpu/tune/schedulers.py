@@ -32,6 +32,10 @@ class TrialScheduler:
                   all_trials: List[Trial]) -> str:
         return Decision.CONTINUE
 
+    def on_trial_complete(self, trial_id: str) -> None:
+        """Trial terminated/errored: schedulers drop per-trial state so
+        long sweeps don't accumulate it unboundedly."""
+
     def score(self, trial_or_result) -> Optional[float]:
         src = trial_or_result.last_result \
             if isinstance(trial_or_result, Trial) else trial_or_result
@@ -93,6 +97,11 @@ class ASHAScheduler(TrialScheduler):
         k = max(1, len(ordered) // self.rf)
         return ordered[k - 1]
 
+    def on_trial_complete(self, trial_id: str) -> None:
+        # rung scores stay (they gate later trials); the per-trial
+        # milestone set is only consulted while the trial reports
+        self._passed.pop(trial_id, None)
+
 
 class PopulationBasedTraining(TrialScheduler):
     """PBT with truncation selection (reference: tune/schedulers/pbt.py:221).
@@ -118,6 +127,9 @@ class PopulationBasedTraining(TrialScheduler):
         self.resample_p = resample_probability
         self.rng = random.Random(seed)
         self._last_perturb: Dict[str, int] = defaultdict(int)
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        self._last_perturb.pop(trial_id, None)
 
     def on_result(self, trial: Trial, result: Dict[str, Any],
                   all_trials: List[Trial]) -> str:
@@ -190,6 +202,16 @@ class MedianStoppingRule(TrialScheduler):
         # snapshots: step -> {trial_id: running_avg}
         self._sums: Dict[str, List[float]] = {}
         self._at_step: Dict[int, Dict[str, float]] = defaultdict(dict)
+        self._seen_steps: Dict[str, set] = defaultdict(set)
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        # a finished trial's running average can't change: drop its
+        # accumulator + dedupe set. The per-step snapshots STAY — they
+        # are the median pool that gates later-arriving trials (removing
+        # them would let every straggler run ungated once the strong
+        # early trials finish).
+        self._sums.pop(trial_id, None)
+        self._seen_steps.pop(trial_id, None)
 
     def on_result(self, trial: Trial, result: Dict[str, Any],
                   all_trials: List[Trial]) -> str:
@@ -197,6 +219,12 @@ class MedianStoppingRule(TrialScheduler):
         if s is None:
             return Decision.CONTINUE
         t = int(result.get(self.time_attr, 0))
+        if t in self._seen_steps[trial.trial_id]:
+            # restore/replay re-reports a step already counted — feeding
+            # it into the running average would double-weight that step
+            # and skew the median gate
+            return Decision.CONTINUE
+        self._seen_steps[trial.trial_id].add(t)
         acc = self._sums.setdefault(trial.trial_id, [0.0, 0])
         acc[0] += s
         acc[1] += 1

@@ -144,6 +144,7 @@ class TuneController:
                 trial.status = TrialStatus.TERMINATED
                 self._stop_actor(trial)
                 running.remove(trial)
+                self.scheduler.on_trial_complete(trial.trial_id)
                 self._save_experiment_state()
                 continue
             self._on_trial_result(trial, result, pending, running)
@@ -168,6 +169,7 @@ class TuneController:
             trial.status = TrialStatus.TERMINATED
             self._stop_actor(trial)
             running.remove(trial)
+            self.scheduler.on_trial_complete(trial.trial_id)
         else:
             trial.pending_ref = trial.actor.next_result.remote()
         self._save_experiment_state()
@@ -195,6 +197,7 @@ class TuneController:
             trial.status = TrialStatus.ERRORED
             trial.error = repr(error)
             running.remove(trial)
+            self.scheduler.on_trial_complete(trial.trial_id)
 
     # --------------------------------------------------------- persistence
     def _save_experiment_state(self) -> None:
