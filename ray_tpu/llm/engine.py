@@ -36,7 +36,6 @@ from ray_tpu.llm.cache import (SCRATCH_PAGE, PageAllocator, SequenceState,
                                make_kv_cache)
 from ray_tpu.llm.model import decode_loop, prefill, prefill_many
 from ray_tpu.models.llama import LlamaConfig, init_params
-from ray_tpu.ops.paged_attention import write_prefill_kv
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -67,17 +66,48 @@ def _write_prefill_pages(k_cache, v_cache, k_all, v_all, true_len, pages,
     cross the host — a host round-trip here dominated TTFT on tunneled
     chips. Caches are donated (no full-pool copy).
     """
-    Tpad = k_all.shape[1]
-    mask = (jnp.arange(Tpad) < true_len)[None, :, None, None]
-    k_all = jnp.where(mask, k_all, 0)
-    v_all = jnp.where(mask, v_all, 0)
-    if t_page <= Tpad:
-        k_all, v_all = k_all[:, :t_page], v_all[:, :t_page]
-    else:
-        pad = [(0, 0), (0, t_page - Tpad), (0, 0), (0, 0)]
-        k_all, v_all = jnp.pad(k_all, pad), jnp.pad(v_all, pad)
-    return jax.vmap(write_prefill_kv, in_axes=(0, 0, 0, 0, None))(
-        k_cache, v_cache, k_all, v_all, pages)
+    from ray_tpu.llm.model import stage_prefill_kv
+    return stage_prefill_kv(k_cache, v_cache, k_all, v_all, true_len,
+                            pages, t_page)
+
+
+@functools.partial(jax.jit, static_argnames=("t_page",),
+                   donate_argnames=("k_cache", "v_cache"))
+def _write_prefill_pages_group(k_cache, v_cache, k_n, v_n, true_lens,
+                               pages_n, t_page):
+    from ray_tpu.llm.model import stage_prefill_kv_group
+    return stage_prefill_kv_group(k_cache, v_cache, k_n, v_n, true_lens,
+                                  pages_n, t_page)
+
+
+class _SingleChipFns:
+    """tp=1 dispatch: the module-level jits, signatures matching
+    llm.tp.TPEngineFns so the engine swaps implementations at one seam."""
+
+    def __init__(self, cfg: LlamaConfig, decode_chunk: int):
+        self.cfg = cfg
+        self._chunk = decode_chunk
+
+    def prefill_tok(self, params, tokens, true_len):
+        return _prefill_tok(params, tokens, true_len, self.cfg)
+
+    def prefill_many_tok(self, params, tokens, true_lens):
+        return _prefill_many_tok(params, tokens, true_lens, self.cfg)
+
+    def write_prefill_pages(self, k_cache, v_cache, k_all, v_all,
+                            true_len, pages, t_page):
+        return _write_prefill_pages(k_cache, v_cache, k_all, v_all,
+                                    true_len, pages, t_page)
+
+    def write_prefill_pages_group(self, k_cache, v_cache, k_n, v_n,
+                                  true_lens, pages_n, t_page):
+        return _write_prefill_pages_group(k_cache, v_cache, k_n, v_n,
+                                          true_lens, pages_n, t_page)
+
+    def decode_loop(self, params, tokens, positions, k_cache, v_cache,
+                    page_table, seq_lens):
+        return decode_loop(params, tokens, positions, k_cache, v_cache,
+                           page_table, seq_lens, self._chunk, self.cfg)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -92,7 +122,8 @@ class InferenceEngine:
                  page_size: int = 16, total_pages: int = 256,
                  max_batch: int = 8, max_seq_len: int = 1024,
                  eos_token: Optional[int] = None, seed: int = 0,
-                 decode_chunk: int = 8, prefill_batch: int = 4):
+                 decode_chunk: int = 8, prefill_batch: int = 4,
+                 tp: int = 1, devices=None):
         self.cfg = cfg
         self.params = params if params is not None \
             else init_params(cfg, jax.random.PRNGKey(seed))
@@ -110,6 +141,20 @@ class InferenceEngine:
         self.prefill_batch = max(1, prefill_batch)
         self.k_cache, self.v_cache = make_kv_cache(cfg, total_pages,
                                                    page_size)
+        # tensor parallelism: tp>1 shards weights + kv-heads over a
+        # ('tp',) mesh and swaps in shard_map'd programs (llm/tp.py);
+        # page allocator / slot bookkeeping below is layout-agnostic
+        self.tp = max(1, tp)
+        self.mesh = None
+        if self.tp > 1:
+            from ray_tpu.llm.tp import TPEngineFns, build_tp_mesh
+            self.mesh = build_tp_mesh(self.tp, devices)
+            self._fns = TPEngineFns(cfg, self.mesh, self.decode_chunk)
+            self.params = self._fns.shard_params(self.params)
+            self.k_cache, self.v_cache = self._fns.shard_caches(
+                self.k_cache, self.v_cache)
+        else:
+            self._fns = _SingleChipFns(cfg, self.decode_chunk)
         self.allocator = PageAllocator(total_pages)
         self.waiting: List[SequenceState] = []
         self.running: List[SequenceState] = []
@@ -192,9 +237,16 @@ class InferenceEngine:
         with self._lock:
             if not self.waiting:
                 return
+            # group size: prefill_batch while sequences are DECODING (a
+            # bigger group would stall their next chunk longer), but with
+            # an idle decode batch nothing is blocked — admit up to every
+            # free slot so a burst of arrivals rides ONE dispatch and
+            # every request's TTFT is the same single prefill (the
+            # concurrent-arrival case the queued-TTFT target measures)
+            cap = self.prefill_batch if self.running else self.max_batch
             bucket = _bucket(len(self.waiting[0].prompt))
             taken: List[int] = []
-            while self.waiting and len(group) < self.prefill_batch:
+            while self.waiting and len(group) < cap:
                 seq = self.waiting[0]
                 if _bucket(len(seq.prompt)) != bucket:
                     break  # different compile bucket: next step's group
@@ -218,8 +270,8 @@ class InferenceEngine:
             T = len(seq.prompt)
             tokens = np.zeros((1, Tpad), np.int32)
             tokens[0, :T] = seq.prompt
-            tok, k_all, v_all = _prefill_tok(
-                self.params, jnp.asarray(tokens), jnp.int32(T), self.cfg)
+            tok, k_all, v_all = self._fns.prefill_tok(
+                self.params, jnp.asarray(tokens), jnp.int32(T))
             self._postfill(seq, slot, pages, int(tok), k_all, v_all)
             return
         # batched path: pad the group to a power-of-two size so compile
@@ -232,30 +284,47 @@ class InferenceEngine:
         for i, (seq, _, _) in enumerate(group):
             tokens[i, :len(seq.prompt)] = seq.prompt
             lens[i] = len(seq.prompt)
-        toks_n, k_n, v_n = _prefill_many_tok(
-            self.params, jnp.asarray(tokens), jnp.asarray(lens), self.cfg)
+        toks_n, k_n, v_n = self._fns.prefill_many_tok(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens))
         # ONE blocking readback for the whole group's first tokens (argmax
-        # fused into the prefill program); the per-sequence KV writes below
-        # are async dispatches, so the group costs ~1 host round-trip
+        # fused into the prefill program), then ONE fused scatter writes
+        # every sequence's prompt KV into its pages — 2N per-sequence
+        # write dispatches collapsed to 2, which on a remote/tunneled
+        # device takes ~100ms of host dispatch latency off the NEXT
+        # group's first token
         first_toks = np.asarray(toks_n)
+        n_pages_max = max(len(p) for _, _, p in group)
+        t_page = n_pages_max * self.page_size
+        pages_n = np.full((Npad, n_pages_max), SCRATCH_PAGE, np.int32)
+        wlens = np.zeros(Npad, np.int32)  # pad rows: 0 -> all-zero write
+        for i, (seq, _, pages) in enumerate(group):
+            pages_n[i, :len(pages)] = pages
+            wlens[i] = len(seq.prompt)
+        self.k_cache, self.v_cache = self._fns.write_prefill_pages_group(
+            self.k_cache, self.v_cache, k_n, v_n, jnp.asarray(wlens),
+            jnp.asarray(pages_n), t_page)
         for i, (seq, slot, pages) in enumerate(group):
-            self._postfill(seq, slot, pages, int(first_toks[i]),
-                           k_n[i], v_n[i])
+            self._postfill_book(seq, slot, pages, int(first_toks[i]))
 
     def _postfill(self, seq: SequenceState, slot: int, pages: List[int],
                   first_tok: int, k_all, v_all) -> None:
-        """Per-sequence bookkeeping after its prompt forward pass: write
-        K/V into the sequence's pages (async dispatch), then either
-        finish immediately (EOS / 1-token budget) or join the decode
-        batch with the already-sampled first token."""
+        """Single-prompt path: write the prompt K/V into its pages (async
+        dispatch), then the shared bookkeeping."""
         T = len(seq.prompt)
         Tpage = len(pages) * self.page_size
         pages_arr = jnp.asarray(pages, jnp.int32)
-        self.k_cache, self.v_cache = _write_prefill_pages(
+        self.k_cache, self.v_cache = self._fns.write_prefill_pages(
             self.k_cache, self.v_cache, k_all, v_all, jnp.int32(T),
             pages_arr, Tpage)
+        self._postfill_book(seq, slot, pages, first_tok)
+
+    def _postfill_book(self, seq: SequenceState, slot: int,
+                       pages: List[int], first_tok: int) -> None:
+        """Post-prefill bookkeeping: either finish immediately (EOS /
+        1-token budget) or join the decode batch with the already-sampled
+        first token."""
         seq.pages = pages
-        self.stats["prefill_tokens"] += T
+        self.stats["prefill_tokens"] += len(seq.prompt)
         done_now = seq.max_new_tokens <= 1 \
             or (self.eos_token is not None and first_tok == self.eos_token)
         if done_now:
@@ -328,12 +397,11 @@ class InferenceEngine:
         seq_lens = np.ones(self.max_batch, np.int32)
         for i, s in active:
             seq_lens[i] = s.num_tokens
-        toks_out, self.k_cache, self.v_cache, _, _ = decode_loop(
+        toks_out, self.k_cache, self.v_cache, _, _ = self._fns.decode_loop(
             self.params, jnp.asarray(self._tokens),
             jnp.asarray(self._positions),
             self.k_cache, self.v_cache,
-            jnp.asarray(self._page_table), jnp.asarray(seq_lens),
-            K, self.cfg)
+            jnp.asarray(self._page_table), jnp.asarray(seq_lens))
         block = np.asarray(toks_out)               # [K, B], ONE readback
         self.stats["decode_steps"] += K
         self.stats["decode_tokens"] += K * len(active)

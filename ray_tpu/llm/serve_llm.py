@@ -210,3 +210,74 @@ class LLMServer:
     def check_health(self) -> None:
         if not self._thread.is_alive():
             raise RuntimeError("engine thread died")
+
+
+# ---------------------------------------------------------------------------
+# Placement derivation: parallel degrees -> gang bundles
+# ---------------------------------------------------------------------------
+
+def placement_for_engine(tp: int = 1, pp: int = 1,
+                         chips_per_host: int = 8):
+    """(bundles, strategy) derived from the engine's parallel degrees —
+    the reference computes the same from TP×PP engine_kwargs (reference:
+    llm/_internal/serve/deployments/llm/vllm/vllm_models.py:128-153).
+
+    TPU mapping: a tp-group must sit on ICI, so a group that fits one
+    host is ONE bundle of tp chips (STRICT_PACK — same host, adjacent
+    chips); a group spanning hosts becomes one whole-host bundle per
+    host, PACKed so the slice stays ICI-contiguous.
+    """
+    world = max(1, int(tp)) * max(1, int(pp))
+    if world <= chips_per_host:
+        return [{"TPU": float(world)}], "STRICT_PACK"
+    if world % chips_per_host:
+        raise ValueError(
+            f"tp*pp={world} spans hosts but is not a multiple of "
+            f"chips_per_host={chips_per_host}")
+    n_hosts = world // chips_per_host
+    return ([{"TPU": float(chips_per_host)}] * n_hosts), "PACK"
+
+
+def build_llm_app(model_config: Optional[Dict[str, Any]] = None,
+                  engine_config: Optional[Dict[str, Any]] = None, *,
+                  name: str = "llm", num_replicas: int = 1,
+                  max_ongoing_requests: int = 16,
+                  runtime_env: Optional[Dict[str, Any]] = None,
+                  use_tpu_resources: Optional[bool] = None,
+                  model_name: str = "rtpu-llm"):
+    """Bind an LLMServer deployment whose replica resources are DERIVED
+    from the engine's tensor-parallel degree (reference: the LLM
+    deployment's placement-group shorthand, vllm_models.py:128-153).
+
+    tp > 1 replicas reserve a {"TPU": tp} gang on one host — the engine
+    process drives all tp chips through one jax Mesh, so the gang and
+    the mesh are the same object. ``use_tpu_resources=False`` (or
+    leaving it None on a TPU-less test cluster... pass False) skips the
+    chip reservation so CPU-mesh tests can deploy the sharded engine.
+
+    A tp-group larger than one host's chips needs one engine process
+    per host under ``jax.distributed`` — not served by this builder;
+    ``placement_for_engine`` already computes the multi-host bundles
+    for when the serve controller grows PG-backed replicas.
+    """
+    from ray_tpu import serve as serve_mod
+    engine_config = dict(engine_config or {})
+    tp = int(engine_config.get("tp", 1))
+    ray_actor_options: Dict[str, Any] = {}
+    if use_tpu_resources is None:
+        use_tpu_resources = tp > 1
+    if tp > 1 and use_tpu_resources:
+        bundles, strategy = placement_for_engine(tp)
+        if len(bundles) > 1:
+            raise NotImplementedError(
+                "tp groups spanning hosts need one engine process per "
+                "host (jax.distributed); shard within one host's chips "
+                "or raise chips_per_host")
+        ray_actor_options["resources"] = bundles[0]
+    if runtime_env:
+        ray_actor_options["runtime_env"] = runtime_env
+    dep = serve_mod.deployment(
+        name=name, num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        ray_actor_options=ray_actor_options or None)(LLMServer)
+    return dep.bind(model_config, engine_config, None, model_name)

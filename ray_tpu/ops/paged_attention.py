@@ -167,21 +167,27 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_table, seq_lens,
     )(page_table, seq_lens, q, k_pages, v_pages)
 
 
-def kernels_supported() -> bool:
+def kernels_supported(device: Optional[jax.Device] = None) -> bool:
     if not _HAS_PALLAS:
         return False
-    dev = jax.devices()[0]
+    dev = device if device is not None else jax.devices()[0]
     return dev.platform == "tpu" or getattr(dev, "device_kind",
                                             "").startswith("TPU")
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens, *,
                     sm_scale: Optional[float] = None,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    impl: Optional[str] = None) -> jax.Array:
     """Dispatch: Pallas kernel on TPU, gather reference elsewhere.
 
     ``interpret=True`` forces the kernel through the Pallas interpreter
     (CPU) — used by tests to validate the kernel itself off-TPU.
+    ``impl`` pins the implementation outright ("kernel" | "reference"):
+    code that compiles for a SPECIFIC mesh (the tp serving engine) must
+    choose by the mesh's platform, because the process's default backend
+    (what the interpret=None autodetect sees) can be a different
+    accelerator than the mesh the program runs on.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -189,8 +195,14 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, *,
         raise ValueError(
             f"q heads {q.shape[1]} not a multiple of kv heads "
             f"{k_pages.shape[1]}")
+    if impl == "reference":
+        return paged_attention_reference(
+            q, k_pages, v_pages, page_table, seq_lens, sm_scale=sm_scale)
+    if impl is not None and impl != "kernel":
+        raise ValueError(f"impl must be 'kernel' or 'reference', "
+                         f"got {impl!r}")
     if interpret is None:
-        if not kernels_supported():
+        if impl is None and not kernels_supported():
             return paged_attention_reference(
                 q, k_pages, v_pages, page_table, seq_lens,
                 sm_scale=sm_scale)

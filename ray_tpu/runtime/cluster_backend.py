@@ -66,13 +66,20 @@ class _Lease:
 
 
 class _PendingTask:
-    __slots__ = ("payload", "spec", "pins", "attempts")
+    __slots__ = ("payload", "spec", "pins", "attempts", "failed_addrs")
 
     def __init__(self, payload: dict, spec: TaskSpec, pins: list):
         self.payload = payload
         self.spec = spec
         self.pins = pins          # ObjectIDs pinned until reply
         self.attempts = 0
+        # addresses this task already failed on: the retry budget counts
+        # DISTINCT workers, so a slow corpse-detection window (attempts
+        # 1..N all landing on one dead port in microseconds) cannot
+        # exhaust max_retries (reference semantics: owner-side
+        # max_retries counts EXECUTIONS, task_manager.h:219 — a push
+        # that never reached a live worker is not an execution)
+        self.failed_addrs: set = set()
 
 
 class _BatchState:
@@ -246,6 +253,13 @@ class _TaskSubmitter:
                 lease = _Lease(grant["lease_id"], grant["worker_addr"],
                                grant["worker_id"],
                                node_addr=grant.get("node_addr", ""))
+                if self.backend.is_dead_addr(lease.worker_addr):
+                    # the head re-granted a worker we watched die (its
+                    # corpse detection hasn't fired yet): hand it back
+                    # and wait out the window instead of burning a push
+                    self._release_to_cluster(lease)
+                    time.sleep(0.1)
+                    continue
                 with self.lock:
                     self.leases[lease.lease_id] = lease
                 break
@@ -304,6 +318,7 @@ class _TaskSubmitter:
         # budget in microseconds (native transport fails dead-addr pushes
         # instantly)
         dead_addr = state.lease.worker_addr
+        self.backend.mark_dead_addr(dead_addr)
         with self.lock:
             stale = [l for l in self.leases.values()
                      if l.worker_addr == dead_addr]
@@ -311,6 +326,13 @@ class _TaskSubmitter:
             self._drop_lease(l)
         retry = []
         for task, exc in state.failed:
+            if dead_addr in task.failed_addrs:
+                # repeat hit on an address this task ALREADY died on: the
+                # push never reached a live worker, so it doesn't consume
+                # retry budget (budget counts distinct leases/addresses)
+                task.attempts -= 1
+            else:
+                task.failed_addrs.add(dead_addr)
             if isinstance(exc, RpcError) and \
                     task.attempts <= task.spec.max_retries:
                 retry.append(task)
@@ -644,6 +666,12 @@ class ClusterBackend:
             collections.OrderedDict()
         self._lineage_cap = 8192
         self._lock = threading.Lock()
+        # worker addresses observed dead (push transport failure), with
+        # expiry: lease grants naming one are released and re-requested
+        # instead of burning a push on a known corpse — covers the window
+        # between a worker's death and the node/head noticing it
+        self._dead_addrs: Dict[str, float] = {}
+        self._dead_addrs_lock = threading.Lock()
 
         worker.worker_id = worker_id or WorkerID.from_random()
 
@@ -845,6 +873,29 @@ class ClusterBackend:
             except RpcError:
                 pass
         return bool(self.head.call("kv_del", {"key": key}, timeout=5.0))
+
+    #: how long a dead address stays blacklisted — a fresh worker at the
+    #: same host gets a new port, so false positives only cost one
+    #: re-request; sized to the worst observed corpse-detection window
+    DEAD_ADDR_TTL_S = 5.0
+
+    def mark_dead_addr(self, addr: str) -> None:
+        with self._dead_addrs_lock:
+            self._dead_addrs[addr] = time.monotonic() + self.DEAD_ADDR_TTL_S
+            if len(self._dead_addrs) > 256:
+                now = time.monotonic()
+                self._dead_addrs = {a: t for a, t in
+                                    self._dead_addrs.items() if t > now}
+
+    def is_dead_addr(self, addr: str) -> bool:
+        with self._dead_addrs_lock:
+            t = self._dead_addrs.get(addr)
+            if t is None:
+                return False
+            if t <= time.monotonic():
+                del self._dead_addrs[addr]
+                return False
+            return True
 
     def _fast_retry(self, op: int, key: bytes, val: bytes = b"",
                     flags: int = 0) -> tuple:

@@ -607,9 +607,14 @@ class ObjectPlane:
             except (RpcError, ObjectLostError):
                 pass
 
+    #: attempts per late delete — a node mid-restart needs a couple of
+    #: rounds; a node that never answers is presumed gone (its arena
+    #: dies with it, so nothing leaks by giving up)
+    _LATE_DELETE_TRIES = 3
+
     def _queue_late_deletes(self, key: bytes, nodes: list) -> None:
         with self._lock:
-            self._late_deletes.extend((n, key) for n in nodes)
+            self._late_deletes.extend((n, key, 0) for n in nodes)
             if self._late_thread_live:
                 return
             self._late_thread_live = True
@@ -625,17 +630,20 @@ class ObjectPlane:
                     if not batch:
                         self._late_thread_live = False
                         return
-                try:
-                    self.refresh_nodes()
-                except Exception:  # noqa: BLE001 — head gone: give up
-                    with self._lock:
-                        self._late_thread_live = False
-                    return
-                for n, key in batch:
+                self.refresh_nodes()  # swallows head errors; stale
+                # addrs then fail the send below and re-queue
+                requeue = []
+                for n, key, tries in batch:
                     addr = self.node_addrs.get(n)
-                    if addr is not None:
-                        self._peers.get(addr).oneway(
-                            "delete_object", {"object_id": key})
+                    if addr is None:
+                        continue  # node left the cluster: arena is gone
+                    if not self._peers.get(addr).oneway(
+                            "delete_object", {"object_id": key}) \
+                            and tries + 1 < self._LATE_DELETE_TRIES:
+                        requeue.append((n, key, tries + 1))
+                if requeue:
+                    with self._lock:
+                        self._late_deletes.extend(requeue)
         except Exception:  # noqa: BLE001 — best-effort cleanup
             with self._lock:
                 self._late_thread_live = False

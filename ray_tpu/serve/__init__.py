@@ -77,6 +77,7 @@ def deployment(target=None, *, name: Optional[str] = None,
         "max_ongoing_requests": max_ongoing_requests,
         "resources": (ray_actor_options or {}).get("resources",
                                                    {"CPU": 0.1}),
+        "runtime_env": (ray_actor_options or {}).get("runtime_env"),
         "user_config": user_config,
         "autoscaling_config": autoscaling_config,
     }
@@ -140,6 +141,7 @@ def _deploy_application(controller, app: Application,
         "num_replicas": d._config["num_replicas"],
         "max_ongoing_requests": d._config["max_ongoing_requests"],
         "resources": d._config["resources"],
+        "runtime_env": d._config.get("runtime_env"),
         "user_config": d._config["user_config"],
         "autoscaling_config": d._config["autoscaling_config"],
     }

@@ -109,7 +109,7 @@ class ServeController:
 
     #: spec keys whose change requires replacing replica actors
     _RESTART_KEYS = ("serialized_callable", "init_args", "init_kwargs",
-                     "max_ongoing_requests", "resources")
+                     "max_ongoing_requests", "resources", "runtime_env")
 
     def deploy(self, name: str, spec: Dict[str, Any]) -> bool:
         """Set/replace a deployment's target state. spec keys:
@@ -275,6 +275,11 @@ class ServeController:
                  if k != "CPU"}
         if extra:
             opts["resources"] = extra
+        if spec.get("runtime_env"):
+            # per-deployment env (env_vars/working_dir) travels to the
+            # replica worker (reference: serve replicas inherit the
+            # deployment's ray_actor_options runtime_env)
+            opts["runtime_env"] = spec["runtime_env"]
         cls = ray_tpu.remote(**opts)(Replica)
         return cls.remote(st.name, rid, spec["serialized_callable"],
                           tuple(spec.get("init_args") or ()),

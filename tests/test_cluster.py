@@ -304,6 +304,31 @@ def test_task_retry_on_worker_death(cluster_rt):
             os.unlink(marker)
 
 
+def test_retry_survives_corpse_leases(cluster_rt):
+    """Deterministic corpse-window test: kill a pooled worker BEFORE
+    submitting, so early leases deterministically name a dead address.
+    With per-distinct-address retry accounting + the dead-addr grant
+    filter (reference semantics: owner max_retries counts executions,
+    task_manager.h:219), max_retries=1 tasks must all still succeed —
+    repeated pushes into one corpse must not burn the budget."""
+    @rt.remote
+    def whoami():
+        return os.getpid()
+
+    # warm the pool and learn a victim pid
+    pids = set(rt.get([whoami.remote() for _ in range(4)], timeout=90))
+    victim = next(iter(pids))
+    os.kill(victim, signal.SIGKILL)
+    # no settling sleep: submitting IMMEDIATELY is the point — some of
+    # these tasks race into the corpse's still-cached leases
+    @rt.remote(max_retries=1)
+    def ping(i):
+        return i * 2
+
+    out = rt.get([ping.remote(i) for i in range(16)], timeout=120)
+    assert out == [i * 2 for i in range(16)]
+
+
 def test_actor_restart_and_exhaustion(cluster_rt):
     @rt.remote(max_restarts=1)
     class Svc:

@@ -10,7 +10,9 @@ from ray_tpu import serve
 
 @pytest.fixture(scope="module")
 def serve_rt():
-    rt.init(num_cpus=4, _system_config={
+    # 8 TPU resources let the tp>1 deployment's derived {"TPU": tp} gang
+    # reservation schedule on the test cluster
+    rt.init(num_cpus=4, resources={"TPU": 8}, _system_config={
         "object_store_memory_bytes": 128 * 1024 * 1024,
     })
     yield rt
@@ -37,3 +39,44 @@ def test_llm_deployment_concurrent_requests(serve_rt):
     stats = h.stats.remote().result(timeout=60)
     # continuous batching + chunking: 18 tokens in a handful of dispatches
     assert stats["decode_dispatches"] < 9, stats
+
+
+def test_llm_tp_deployment_gang_resources(serve_rt):
+    """A tp=2 engine deploys through build_llm_app: replica resources are
+    DERIVED from the tp degree ({'TPU': 2} STRICT_PACK gang — reference:
+    vllm_models.py:128-153 placement from TP×PP), the replica worker
+    shards the engine over a 2-device mesh (virtual CPU devices via the
+    deployment's runtime_env), and generation matches the tp=1
+    deployment's greedy stream."""
+    from ray_tpu.llm import build_llm_app, placement_for_engine
+
+    bundles, strategy = placement_for_engine(tp=2)
+    assert bundles == [{"TPU": 2.0}] and strategy == "STRICT_PACK"
+    bundles, strategy = placement_for_engine(tp=8, pp=2)
+    assert bundles == [{"TPU": 8.0}] * 2 and strategy == "PACK"
+
+    model_cfg = {"n_layers": 2}
+    eng_cfg = {"page_size": 8, "total_pages": 64, "max_batch": 4,
+               "max_seq_len": 128, "seed": 7}
+    env = {"env_vars": {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }}
+    app_tp = build_llm_app(model_cfg, {**eng_cfg, "tp": 2}, name="llm-tp2",
+                           runtime_env=env)
+    h_tp = serve.run(app_tp, timeout_s=300)
+    out_tp = h_tp.remote(
+        {"prompt_ids": [5, 17, 42, 9], "max_tokens": 6}).result(timeout=300)
+
+    app_1 = build_llm_app(model_cfg, eng_cfg, name="llm-tp1",
+                          runtime_env=env)
+    h_1 = serve.run(app_1, timeout_s=300)
+    out_1 = h_1.remote(
+        {"prompt_ids": [5, 17, 42, 9], "max_tokens": 6}).result(timeout=300)
+    assert out_tp["token_ids"] == out_1["token_ids"]
+
+    # the tp replica really reserved its chip gang on the node
+    avail = serve_rt.available_resources()
+    assert avail.get("TPU", 0) <= 6.0, avail
+    serve.delete("llm-tp2")
+    serve.delete("llm-tp1")
