@@ -123,6 +123,7 @@ class InferenceEngine:
                  max_batch: int = 8, max_seq_len: int = 1024,
                  eos_token: Optional[int] = None, seed: int = 0,
                  decode_chunk: int = 8, prefill_batch: int = 4,
+                 prefill_burst: Optional[int] = None,
                  tp: int = 1, devices=None):
         self.cfg = cfg
         self.params = params if params is not None \
@@ -137,8 +138,15 @@ class InferenceEngine:
         # scheduling); finished sequences overshoot at most K-1 tokens
         self.decode_chunk = max(1, decode_chunk)
         # prompts admitted per prefill dispatch (same length bucket):
-        # amortizes dispatch + compute across a deep admission queue
+        # amortizes dispatch + compute across a deep admission queue.
+        # prefill_batch bounds groups while sequences are DECODING (a big
+        # group stalls their next chunk); prefill_burst bounds the
+        # idle-batch burst (default: max_batch). Memory-tight configs
+        # whose prefill_batch exists to bound staged-KV peak should set
+        # prefill_burst to the same value.
         self.prefill_batch = max(1, prefill_batch)
+        self.prefill_burst = max_batch if prefill_burst is None \
+            else max(1, prefill_burst)
         self.k_cache, self.v_cache = make_kv_cache(cfg, total_pages,
                                                    page_size)
         # tensor parallelism: tp>1 shards weights + kv-heads over a
@@ -243,7 +251,7 @@ class InferenceEngine:
             # free slot so a burst of arrivals rides ONE dispatch and
             # every request's TTFT is the same single prefill (the
             # concurrent-arrival case the queued-TTFT target measures)
-            cap = self.prefill_batch if self.running else self.max_batch
+            cap = self.prefill_batch if self.running else self.prefill_burst
             bucket = _bucket(len(self.waiting[0].prompt))
             taken: List[int] = []
             while self.waiting and len(group) < cap:

@@ -33,12 +33,14 @@ class LLMServer:
 
     def __init__(self, model_config: Optional[Dict[str, Any]] = None,
                  engine_config: Optional[Dict[str, Any]] = None,
-                 tokenizer=None, model_name: str = "rtpu-llm"):
+                 tokenizer=None, model_name: str = "rtpu-llm",
+                 chat_template=None):
         cfg = LlamaConfig.tiny(**(model_config or {}))
         self.engine = InferenceEngine(cfg, **(engine_config or {}))
         self.engine.track_progress = True  # the serve loop drains it
         self.tokenizer = tokenizer or ByteTokenizer()
         self.model_name = model_name
+        self.chat_template = chat_template or apply_chat_template
         self._results: Dict[str, List[int]] = {}
         self._events: Dict[str, threading.Event] = {}
         self._abandoned: set = set()
@@ -204,12 +206,96 @@ class LLMServer:
             chunk.pop("usage")
             yield chunk
 
+    # ----------------------------------------------------- chat completions
+
+    def _chat_prompt_ids(self, request: Dict[str, Any]) -> List[int]:
+        messages = request.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("chat request needs a non-empty 'messages' "
+                             "list")
+        return self.tokenizer.encode(self.chat_template(messages))
+
+    def _chat_body(self, rid: str, content: str, n_prompt: int,
+                   n_out: int, finish_reason) -> Dict[str, Any]:
+        return {
+            "id": f"chatcmpl-{rid}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant",
+                                     "content": content},
+                         "finish_reason": finish_reason}],
+            "usage": {"prompt_tokens": n_prompt,
+                      "completion_tokens": n_out,
+                      "total_tokens": n_prompt + n_out},
+        }
+
+    def chat_completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI-style /v1/chat/completions, non-streaming: role-templated
+        messages -> prompt, assistant message back (reference:
+        llm/_internal/serve/configs/openai_api_models.py
+        ChatCompletionRequest/Response)."""
+        prompt = self._chat_prompt_ids(request)
+        out = self.__call__({"prompt_ids": prompt,
+                             "max_tokens": request.get("max_tokens", 32)})
+        toks = out["token_ids"]
+        return self._chat_body(
+            out["request_id"], self.tokenizer.decode(toks), len(prompt),
+            len(toks), self.engine.finish_reason(out["request_id"]))
+
+    def chat_completions_stream(self, request: Dict[str, Any]
+                                ) -> Iterator[Dict[str, Any]]:
+        """OpenAI chat streaming chunks: first delta carries the role,
+        then content deltas, then the terminal chunk with finish_reason +
+        usage (SSE framing happens in the proxy)."""
+        prompt = self._chat_prompt_ids(request)
+        first = True
+        for item in self.stream({"prompt_ids": prompt,
+                                 "max_tokens":
+                                     request.get("max_tokens", 32)}):
+            rid = item["request_id"]
+            if item.get("done"):
+                chunk = self._chat_body(
+                    rid, "", len(prompt), len(item.get("token_ids", ())),
+                    item.get("finish_reason", "length"))
+                chunk["object"] = "chat.completion.chunk"
+                chunk["choices"][0]["delta"] = {}
+                del chunk["choices"][0]["message"]
+                yield chunk
+                return
+            delta: Dict[str, Any] = {
+                "content": self.tokenizer.decode(item["token_ids"])}
+            if first:
+                delta = {"role": "assistant", **delta}
+                first = False
+            chunk = self._chat_body(rid, "", len(prompt), 0, None)
+            chunk["object"] = "chat.completion.chunk"
+            chunk["choices"][0]["delta"] = delta
+            del chunk["choices"][0]["message"]
+            chunk.pop("usage")
+            yield chunk
+
     def stats(self) -> Dict[str, Any]:
         return dict(self.engine.stats)
 
     def check_health(self) -> None:
         if not self._thread.is_alive():
             raise RuntimeError("engine thread died")
+
+
+def apply_chat_template(messages: List[Dict[str, Any]]) -> str:
+    """Default role templating (reference: the router templates chat
+    messages through the model's tokenizer chat template; this framework's
+    byte-level tokenizer uses an explicit llama-chat-style marker form —
+    swap per model via LLMServer(chat_template=...))."""
+    parts = []
+    for m in messages:
+        role = str(m.get("role", "user"))
+        content = str(m.get("content", ""))
+        parts.append(f"<|{role}|>\n{content}")
+    parts.append("<|assistant|>\n")
+    return "\n".join(parts)
 
 
 # ---------------------------------------------------------------------------

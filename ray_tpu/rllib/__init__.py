@@ -14,16 +14,19 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.impala import (IMPALA, IMPALAConfig, IMPALALearner,
                                   vtrace)
 from ray_tpu.rllib.replay import ReplayBuffer
-from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVectorEnv, VectorEnv
+from ray_tpu.rllib.env import (ENV_REGISTRY, CartPoleVectorEnv,
+                               PendulumVectorEnv, VectorEnv)
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner, compute_gae
 from ray_tpu.rllib.module import forward, init_module, sample_actions
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
 
 __all__ = [
     "BC", "BCConfig", "BCLearner", "record_dataset",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace",
     "PPO", "PPOConfig", "PPOLearner", "EnvRunner", "VectorEnv",
-    "CartPoleVectorEnv", "ENV_REGISTRY", "compute_gae", "init_module",
-    "forward", "sample_actions",
+    "CartPoleVectorEnv", "PendulumVectorEnv", "ENV_REGISTRY",
+    "SAC", "SACConfig", "SACLearner",
+    "compute_gae", "init_module", "forward", "sample_actions",
 ]

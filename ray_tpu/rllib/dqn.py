@@ -168,4 +168,3 @@ class DQN(TrainerBase):
             episodes=len(returns), t0=t0,
             buffer_size=len(self.buffer), epsilon=round(eps, 4),
             num_updates=self.num_updates, learner=metrics)
-        self.target_params = params

@@ -1,10 +1,11 @@
-"""Vectorized env API + a dependency-free CartPole.
+"""Vectorized env API + dependency-free CartPole and Pendulum.
 
 Role-equivalent to the reference's env layer (reference:
 rllib/env/single_agent_env_runner.py:66 runs gym vector envs): a VectorEnv
 steps B environments in lockstep with numpy arrays — auto-resetting done
 envs, the convention the runner's trajectory collection assumes.
-CartPole-v1 dynamics reimplemented in numpy (no gym in the image).
+CartPole-v1 (discrete) and Pendulum-v1 (continuous control) dynamics
+reimplemented in numpy (no gym in the image).
 """
 
 from __future__ import annotations
@@ -17,14 +18,20 @@ import numpy as np
 class VectorEnv:
     num_envs: int
     observation_dim: int
-    num_actions: int
+    #: discrete envs set num_actions; continuous envs set
+    #: continuous=True + action_dim + action_scale instead
+    num_actions: int = 0
+    continuous: bool = False
+    action_dim: int = 0
+    action_scale: float = 1.0
 
     def reset(self, seed: int = 0) -> np.ndarray:
         raise NotImplementedError
 
     def step(self, actions: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict[str, Any]]:
-        """actions [B] -> (obs [B, D], rewards [B], dones [B], info).
+        """actions [B] (discrete) or [B, action_dim] (continuous) ->
+        (obs [B, D], rewards [B], dones [B], info).
         Done envs auto-reset; obs is the NEW episode's first obs."""
         raise NotImplementedError
 
@@ -97,4 +104,74 @@ class CartPoleVectorEnv(VectorEnv):
                 dones.astype(np.bool_), {})
 
 
-ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv}
+class PendulumVectorEnv(VectorEnv):
+    """Pendulum-v1 dynamics (standard constants), vectorized — the
+    CONTINUOUS-control env (torque in [-2, 2]) the SAC stack trains on.
+
+    obs = [cos θ, sin θ, θ̇]; cost = θ̄² + 0.1·θ̇² + 0.001·u²
+    (θ̄ = angle wrapped to [-π, π]); fixed 200-step episodes (time-limit
+    truncation, never early termination). Random policy ≈ -1200 mean
+    return; a trained SAC policy reaches ≈ -150..-250.
+    """
+
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    MAX_STEPS = 200
+
+    continuous = True
+    action_dim = 1
+    action_scale = MAX_TORQUE
+
+    def __init__(self, num_envs: int):
+        self.num_envs = num_envs
+        self.observation_dim = 3
+        self._th = np.zeros(num_envs)
+        self._thdot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, np.int64)
+        self._rng = np.random.default_rng(0)
+        self.episode_returns: list = []
+        self._ret = np.zeros(num_envs)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._th), np.sin(self._th),
+                         self._thdot], axis=1).astype(np.float32)
+
+    def reset(self, seed: int = 0) -> np.ndarray:
+        self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi, self.num_envs)
+        self._thdot = self._rng.uniform(-1.0, 1.0, self.num_envs)
+        self._steps[:] = 0
+        self._ret[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th_wrapped = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        cost = (th_wrapped ** 2 + 0.1 * self._thdot ** 2
+                + 0.001 * u ** 2)
+        self._thdot = np.clip(
+            self._thdot + (3 * self.G / (2 * self.L) * np.sin(self._th)
+                           + 3.0 / (self.M * self.L ** 2) * u) * self.DT,
+            -self.MAX_SPEED, self.MAX_SPEED)
+        self._th = self._th + self._thdot * self.DT
+        self._steps += 1
+        rewards = (-cost).astype(np.float32)
+        self._ret += rewards
+        dones = self._steps >= self.MAX_STEPS
+        if dones.any():
+            idx = np.flatnonzero(dones)
+            self.episode_returns.extend(self._ret[idx].tolist())
+            self._th[idx] = self._rng.uniform(-np.pi, np.pi, len(idx))
+            self._thdot[idx] = self._rng.uniform(-1.0, 1.0, len(idx))
+            self._steps[idx] = 0
+            self._ret[idx] = 0
+        return self._obs(), rewards, dones.astype(np.bool_), {}
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv,
+                "Pendulum-v1": PendulumVectorEnv}

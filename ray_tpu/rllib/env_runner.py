@@ -38,11 +38,20 @@ class EnvRunner:
         import jax
         import jax.numpy as jnp
 
-        from ray_tpu.rllib.module import forward, sample_actions
+        from ray_tpu.rllib.module import (forward, sample_actions,
+                                          sample_squashed)
 
         if self.exploration == "categorical":
             def fn(params, obs, key, epsilon):
                 return sample_actions(params, obs, key)
+            return fn
+
+        if self.exploration == "squashed_gaussian":
+            scale = float(self.env.action_scale)
+
+            def fn(params, obs, key, epsilon):
+                a, logp = sample_squashed(params["actor"], obs, key, scale)
+                return a, logp, jnp.zeros(obs.shape[0])
             return fn
 
         def fn(params, obs, key, epsilon):
@@ -72,9 +81,13 @@ class EnvRunner:
         """
         assert self.params is not None, "set_weights before sample"
         T, B = self.rollout_len, self.env.num_envs
+        act_shape = (T, B, self.env.action_dim) \
+            if self.env.continuous else (T, B)
         out = {
             "obs": np.zeros((T, B, self.env.observation_dim), np.float32),
-            "actions": np.zeros((T, B), np.int32),
+            "actions": np.zeros(act_shape,
+                                np.float32 if self.env.continuous
+                                else np.int32),
             "logp": np.zeros((T, B), np.float32),
             "values": np.zeros((T, B), np.float32),
             "rewards": np.zeros((T, B), np.float32),

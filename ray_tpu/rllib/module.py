@@ -52,3 +52,82 @@ def sample_actions(params: Params, obs: jnp.ndarray, key: jax.Array):
     actions = jax.random.categorical(key, logits)
     logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), actions]
     return actions, logp, value
+
+
+# ---------------------------------------------------------------------------
+# Continuous control (SAC): squashed-Gaussian actor + twin Q critics
+# (reference: rllib/algorithms/sac/sac_catalog — SACTorchModel's policy
+# and twin-Q nets; functional-JAX pytrees here)
+# ---------------------------------------------------------------------------
+
+LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+
+def _init_mlp(key, sizes, out_dim, out_scale=0.01) -> Params:
+    params: Params = {}
+    keys = jax.random.split(key, len(sizes))
+    for i in range(len(sizes) - 1):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5
+        params[f"b{i}"] = jnp.zeros(sizes[i + 1])
+    params["w_out"] = jax.random.normal(
+        keys[-1], (sizes[-1], out_dim)) * out_scale
+    params["b_out"] = jnp.zeros(out_dim)
+    return params
+
+
+def _mlp_forward(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    n = sum(1 for k in params if k[0] == "w" and k[1:].isdigit())
+    for i in range(n):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    return x @ params["w_out"] + params["b_out"]
+
+
+def init_sac_module(key: jax.Array, obs_dim: int, action_dim: int,
+                    hidden: Tuple[int, ...] = (64, 64)) -> Params:
+    """{"actor", "q1", "q2"}: actor emits [mean, log_std] (2*A outputs);
+    critics score (obs ++ action) -> scalar."""
+    ka, k1, k2 = jax.random.split(key, 3)
+    sizes = (obs_dim,) + hidden
+    qsizes = (obs_dim + action_dim,) + hidden
+    return {
+        "actor": _init_mlp(ka, sizes, 2 * action_dim),
+        "q1": _init_mlp(k1, qsizes, 1, out_scale=1.0),
+        "q2": _init_mlp(k2, qsizes, 1, out_scale=1.0),
+    }
+
+
+def q_forward(qparams: Params, obs: jnp.ndarray,
+              action: jnp.ndarray) -> jnp.ndarray:
+    """(obs [B, D], action [B, A]) -> q [B]."""
+    return _mlp_forward(qparams, jnp.concatenate([obs, action],
+                                                 axis=-1))[:, 0]
+
+
+def sample_squashed(actor: Params, obs: jnp.ndarray, key: jax.Array,
+                    action_scale: float = 1.0):
+    """Reparameterized tanh-squashed Gaussian: -> (action [B, A] in
+    [-scale, scale], logp [B]) with the tanh log-det correction
+    (reference: SAC's SquashedGaussian action distribution)."""
+    out = _mlp_forward(actor, obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + std * eps
+    # N(pre; mean, std) log-density
+    logp_gauss = (-0.5 * ((pre - mean) / std) ** 2 - log_std
+                  - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+    tanh = jnp.tanh(pre)
+    # log |d tanh/d pre| = log(1 - tanh^2); the numerically-stable form
+    logp = logp_gauss - (2 * (jnp.log(2.0) - pre
+                              - jax.nn.softplus(-2 * pre))).sum(-1)
+    return action_scale * tanh, logp
+
+
+def greedy_squashed(actor: Params, obs: jnp.ndarray,
+                    action_scale: float = 1.0) -> jnp.ndarray:
+    """Deterministic (mean) action for evaluation."""
+    out = _mlp_forward(actor, obs)
+    mean, _ = jnp.split(out, 2, axis=-1)
+    return action_scale * jnp.tanh(mean)

@@ -15,12 +15,18 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: Optional[int] = None):
+        """action_dim=None stores discrete int32 actions [N]; an int
+        stores continuous float32 actions [N, action_dim] (SAC)."""
         self.capacity = int(capacity)
         self._rng = np.random.default_rng(seed)
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        if action_dim is None:
+            self.actions = np.zeros(capacity, np.int32)
+        else:
+            self.actions = np.zeros((capacity, action_dim), np.float32)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.bool_)
         self._write = 0

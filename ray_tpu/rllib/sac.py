@@ -1,0 +1,239 @@
+"""SAC — continuous-control off-policy training (squashed-Gaussian actor,
+twin Q critics, automatic entropy temperature).
+
+Role-equivalent to the reference's SAC (reference: rllib/algorithms/sac/
+sac.py — training_step samples into a replay buffer then runs critic/
+actor/alpha updates with polyak-averaged targets; losses in
+sac/torch/sac_torch_learner.py). TPU-first redesign: the ENTIRE
+iteration's update schedule — N minibatches of critic + actor + alpha
+steps plus the polyak target blend — is ONE jitted ``lax.scan`` program,
+so an iteration costs one device dispatch instead of 3N optimizer calls
+(the reference pays per-op torch dispatch; here XLA fuses the whole
+schedule).
+
+Runs on the same TrainerBase/EnvRunner/ReplayBuffer seams as DQN — the
+runner samples with the reparameterized squashed-Gaussian policy
+(exploration="squashed_gaussian"), proving the seams are not
+discrete-action-shaped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY
+from ray_tpu.rllib.module import (init_sac_module, q_forward,
+                                  sample_squashed)
+from ray_tpu.rllib.replay import ReplayBuffer
+from ray_tpu.rllib.trainer_base import TrainerBase
+
+
+class SACLearner:
+    """One jitted program per train() call: lax.scan over the sampled
+    minibatch stack, each step doing critic MSE to the entropy-penalized
+    double-Q target, reparameterized actor ascent, temperature descent to
+    target_entropy, and the polyak target update."""
+
+    def __init__(self, *, lr: float = 3e-4, gamma: float = 0.99,
+                 tau: float = 0.005, target_entropy: float = -1.0,
+                 action_scale: float = 1.0):
+        import optax
+        self.gamma = gamma
+        self.tau = tau
+        self.target_entropy = target_entropy
+        self.action_scale = action_scale
+        self.opt_critic = optax.adam(lr)
+        self.opt_actor = optax.adam(lr)
+        self.opt_alpha = optax.adam(lr)
+        self.state = None  # (target_q, log_alpha, opt_states)
+        self._update = self._jitted_update()
+
+    def _init_state(self, params):
+        import jax.numpy as jnp
+        critic = {"q1": params["q1"], "q2": params["q2"]}
+        return {
+            "target": critic,
+            "log_alpha": jnp.asarray(0.0),
+            "opt_critic": self.opt_critic.init(critic),
+            "opt_actor": self.opt_actor.init(params["actor"]),
+            "opt_alpha": self.opt_alpha.init(jnp.asarray(0.0)),
+        }
+
+    def _jitted_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        gamma, tau, scale = self.gamma, self.tau, self.action_scale
+        target_entropy = self.target_entropy
+        opt_c, opt_a, opt_t = (self.opt_critic, self.opt_actor,
+                               self.opt_alpha)
+
+        def one_step(carry, batch):
+            params, st, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            alpha = jnp.exp(st["log_alpha"])
+
+            # -- critics: y = r + γ(1-d)(min target-Q(s',a') - α logπ(a'))
+            a2, logp2 = sample_squashed(params["actor"],
+                                        batch["next_obs"], k1, scale)
+            tq = jnp.minimum(
+                q_forward(st["target"]["q1"], batch["next_obs"], a2),
+                q_forward(st["target"]["q2"], batch["next_obs"], a2))
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            y = batch["rewards"] + gamma * nonterminal * \
+                jax.lax.stop_gradient(tq - alpha * logp2)
+
+            def critic_loss(critic):
+                q1 = q_forward(critic["q1"], batch["obs"], batch["actions"])
+                q2 = q_forward(critic["q2"], batch["obs"], batch["actions"])
+                return ((q1 - y) ** 2 + (q2 - y) ** 2).mean()
+
+            critic = {"q1": params["q1"], "q2": params["q2"]}
+            closs, cgrad = jax.value_and_grad(critic_loss)(critic)
+            cupd, oc = opt_c.update(cgrad, st["opt_critic"], critic)
+            critic = optax.apply_updates(critic, cupd)
+
+            # -- actor: max E[min Q(s, a~π) - α logπ]
+            def actor_loss(actor):
+                a, logp = sample_squashed(actor, batch["obs"], k2, scale)
+                q = jnp.minimum(q_forward(critic["q1"], batch["obs"], a),
+                                q_forward(critic["q2"], batch["obs"], a))
+                return (alpha * logp - q).mean(), logp
+
+            (aloss, logp), agrad = jax.value_and_grad(
+                actor_loss, has_aux=True)(params["actor"])
+            aupd, oa = opt_a.update(agrad, st["opt_actor"],
+                                    params["actor"])
+            actor = optax.apply_updates(params["actor"], aupd)
+
+            # -- temperature: drive E[-logπ] toward target_entropy
+            def alpha_loss(log_alpha):
+                return -(log_alpha * jax.lax.stop_gradient(
+                    logp + target_entropy)).mean()
+
+            tloss, tgrad = jax.value_and_grad(alpha_loss)(st["log_alpha"])
+            tupd, ot = opt_t.update(tgrad, st["opt_alpha"],
+                                    st["log_alpha"])
+            log_alpha = optax.apply_updates(st["log_alpha"], tupd)
+
+            target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                  st["target"], critic)
+            params = {"actor": actor, "q1": critic["q1"],
+                      "q2": critic["q2"]}
+            st = {"target": target, "log_alpha": log_alpha,
+                  "opt_critic": oc, "opt_actor": oa, "opt_alpha": ot}
+            return (params, st, key), jnp.stack(
+                [closs, aloss, jnp.exp(log_alpha)])
+
+        @jax.jit
+        def update(params, st, key, batches):
+            (params, st, _), metrics = jax.lax.scan(
+                one_step, (params, st, key), batches)
+            return params, st, metrics.mean(axis=0)
+
+        return update
+
+    def update(self, params, batches: Dict[str, np.ndarray], key):
+        """batches: arrays stacked [N, batch, ...] — the whole
+        iteration's schedule in one dispatch."""
+        import jax.numpy as jnp
+        if self.state is None:
+            self.state = self._init_state(params)
+        jb = {k: jnp.asarray(v) for k, v in batches.items()}
+        params, self.state, m = self._update(params, self.state, key, jb)
+        m = np.asarray(m)
+        return params, {"critic_loss": float(m[0]),
+                        "actor_loss": float(m[1]),
+                        "alpha": float(m[2])}
+
+
+@dataclasses.dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_length: int = 32
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    buffer_capacity: int = 100_000
+    train_batch_size: int = 256
+    # near-1:1 update-to-data ratio (SAC's operating point — at 1:16 the
+    # critic converges but the policy never moves); the whole schedule is
+    # one scanned program, so a big N costs one dispatch
+    updates_per_iter: int = 256
+    learning_starts: int = 1_000
+    target_entropy: float = None   # default: -action_dim
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(TrainerBase):
+    def __init__(self, config: SACConfig):
+        import jax
+        self.config = config
+        spec = ENV_REGISTRY[config.env](1)
+        if not spec.continuous:
+            raise ValueError(f"SAC needs a continuous-action env, "
+                             f"{config.env} is discrete")
+        key = jax.random.PRNGKey(config.seed)
+        self._key, init_key = jax.random.split(key)
+        self.params = init_sac_module(init_key, spec.observation_dim,
+                                      spec.action_dim, config.hidden)
+        te = config.target_entropy
+        self.learner = SACLearner(
+            lr=config.lr, gamma=config.gamma, tau=config.tau,
+            target_entropy=float(-spec.action_dim if te is None else te),
+            action_scale=float(spec.action_scale))
+        self.buffer = ReplayBuffer(config.buffer_capacity,
+                                   spec.observation_dim,
+                                   seed=config.seed,
+                                   action_dim=spec.action_dim)
+        self._make_runners(config.env, config.num_env_runners,
+                           config.num_envs_per_runner,
+                           config.rollout_length, config.seed,
+                           exploration="squashed_gaussian")
+        self.num_updates = 0
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        cfg = self.config
+        t0 = time.monotonic()
+        self._broadcast_weights()
+        batches = ray_tpu.get(
+            [r.sample.remote() for r in self.runners], timeout=600)
+        returns: List[float] = []
+        for b in batches:
+            T, B = b["rewards"].shape
+            next_obs = np.concatenate([b["obs"][1:], b["last_obs"][None]])
+            self.buffer.add_batch(
+                b["obs"].reshape(T * B, -1),
+                b["actions"].reshape(T * B, -1),
+                b["rewards"].reshape(T * B),
+                b["dones"].reshape(T * B),
+                next_obs.reshape(T * B, -1))
+            returns.extend(b["episode_returns"].tolist())
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            # presample the whole schedule, run it as ONE scanned program
+            stack = [self.buffer.sample(cfg.train_batch_size)
+                     for _ in range(cfg.updates_per_iter)]
+            batched = {k: np.stack([s[k] for s in stack])
+                       for k in stack[0]}
+            self._key, sub = jax.random.split(self._key)
+            self.params, metrics = self.learner.update(
+                self.params, batched, sub)
+            self.num_updates += cfg.updates_per_iter
+        self._track_returns(returns)
+        return self._base_result(
+            episodes=len(returns), t0=t0,
+            buffer_size=len(self.buffer),
+            num_updates=self.num_updates, learner=metrics)

@@ -43,15 +43,19 @@ class HTTPProxy:
             def _dispatch(self, body: Any):
                 parts = [p for p in self.path.strip("/").split("/") if p]
                 stream = isinstance(body, dict) and bool(body.get("stream"))
-                # OpenAI-compatible completions: the deployment is the
-                # body's "model" (reference: serve-LLM router)
-                if parts[:2] == ["v1", "completions"]:
+                # OpenAI-compatible completions + chat completions: the
+                # deployment is the body's "model" (reference: serve-LLM
+                # router, configs/openai_api_models.py)
+                openai = (parts[:2] == ["v1", "completions"]
+                          or parts[:3] == ["v1", "chat", "completions"])
+                if openai:
                     if not isinstance(body, dict) or "model" not in body:
                         self._reply(400, {"error": "body needs 'model'"})
                         return
                     name = body["model"]
-                    method = ("completions_stream" if stream
-                              else "completions")
+                    base = ("chat_completions" if parts[1] == "chat"
+                            else "completions")
+                    method = base + ("_stream" if stream else "")
                 else:
                     name = parts[0] if parts else ""
                     method = parts[1] if len(parts) > 1 else None
@@ -68,7 +72,6 @@ class HTTPProxy:
                     self._reply(503, {"error": f"routing unavailable: "
                                                f"{e!r}"})
                     return
-                openai = parts[:2] == ["v1", "completions"]
                 try:
                     if method:
                         if method.startswith("_"):

@@ -37,6 +37,17 @@ class ScalingConfig:
     #: elastic grow-back: how often (seconds) the controller polls cluster
     #: capacity for a mid-run upscale (interrupt + restore at bigger size)
     grow_poll_s: float = 30.0
+    #: hysteresis — grow suppression window (seconds) after a FAILURE
+    #: restart: a killed worker's freed resources read as "capacity
+    #: gained", and without a cooldown the shrunken group would be
+    #: interrupted to grow right back (shrink->grow oscillation on every
+    #: capacity churn). Reference: train/v2 scaling_policy.py:29 leaves
+    #: this to the policy; here it is an explicit knob.
+    grow_cooldown_s: float = 30.0
+    #: hysteresis — minimum seconds a freshly started group runs before
+    #: the grow monitor may interrupt it (rapid successive resizes churn
+    #: checkpoint restores without training progress)
+    grow_min_dwell_s: float = 5.0
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     use_tpu: bool = False
     chips_per_worker: int = 0

@@ -64,6 +64,9 @@ class TrainController:
             num_to_keep=run_config.checkpoint_config.num_to_keep)
         self.failure_policy = FailurePolicy(run_config.failure_config)
         self.scaling_policy = self._build_scaling_policy()
+        # grow hysteresis: monotonic instant before which the grow
+        # monitor must not interrupt (pushed forward by failure restarts)
+        self._grow_allowed_at = 0.0
 
     def _build_scaling_policy(self) -> ScalingPolicy:
         sc = self.scaling
@@ -93,6 +96,10 @@ class TrainController:
         if isinstance(self.scaling_policy, FixedScalingPolicy):
             return  # fixed-size runs never grow; skip the poll thread
         poll = max(0.2, self.scaling.grow_poll_s)
+        # min-dwell: this group must run a while before a grow may
+        # interrupt it; combined with any failure-restart cooldown
+        dwell_until = time.monotonic() + max(
+            0.0, self.scaling.grow_min_dwell_s)
 
         def _mon():
             # Wait until every worker is PLACED before judging capacity:
@@ -105,6 +112,9 @@ class TrainController:
             except Exception:  # noqa: BLE001 — group failing; that path
                 return         # is handled by the failure policy
             while not stop.wait(poll):
+                if time.monotonic() < max(dwell_until,
+                                          self._grow_allowed_at):
+                    continue  # hysteresis window: no grow decisions yet
                 try:
                     target = self.scaling_policy.grow_target(
                         size, self._capacity)
@@ -176,7 +186,12 @@ class TrainController:
                                   metrics_history=history, error=e)
                 # elastic re-mesh: the restarted group re-lowers the train
                 # step over the resized device mesh and restores from the
-                # latest checkpoint (host-numpy pytrees re-shard freely)
+                # latest checkpoint (host-numpy pytrees re-shard freely).
+                # Grow cooldown: the dead worker's freed resources would
+                # otherwise read as capacity gain and bounce the group
+                # right back up (oscillation on churn).
+                self._grow_allowed_at = time.monotonic() + max(
+                    0.0, self.scaling.grow_cooldown_s)
                 size = new_size
                 self.state = ControllerState.RESTARTING
             finally:

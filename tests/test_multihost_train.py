@@ -169,9 +169,14 @@ def test_elastic_shrink_on_worker_loss(cluster_rt, tmp_path):
         scaling_config=train.ScalingConfig(
             num_workers=4,
             min_workers=2,
-            grow_poll_s=3600,  # this test asserts the SHRINK outcome; on
-            # a slow host the killed worker's freed CPU would otherwise
-            # trigger the (correct!) grow-back mid-test
+            # aggressive poll ON PURPOSE: the killed worker's freed CPU
+            # reads as capacity gain immediately, and only the
+            # grow_cooldown_s hysteresis (VERDICT r4 #8) keeps the
+            # shrunken group from bouncing straight back to 4 — this
+            # test now also covers kill+immediate-capacity-return
+            # restarting AT MOST once (world_size stays 3 to the end)
+            grow_poll_s=0.5,
+            grow_cooldown_s=120.0,
             mesh=MeshSpec(dp=-1),
             jax_distributed=True,
             jax_platform="cpu",

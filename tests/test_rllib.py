@@ -187,6 +187,55 @@ def test_impala_learns_cartpole(local_rt):
         algo.stop()
 
 
+def test_pendulum_env_dynamics():
+    from ray_tpu.rllib import PendulumVectorEnv
+    env = PendulumVectorEnv(4)
+    obs = env.reset(seed=0)
+    assert obs.shape == (4, 3)
+    # cos^2 + sin^2 = 1 invariant
+    np.testing.assert_allclose(obs[:, 0] ** 2 + obs[:, 1] ** 2, 1.0,
+                               rtol=1e-6)
+    total = np.zeros(4)
+    for _ in range(200):
+        obs, r, dones, _ = env.step(np.zeros((4, 1), np.float32))
+        assert (r <= 0).all()          # cost-based reward is never positive
+        total += r
+    assert dones.all(), "episodes must time-limit at 200 steps"
+    assert len(env.episode_returns) == 4
+    # zero-torque returns are bad but bounded
+    assert (total > -2000).all() and (total < -100).all(), total
+
+
+def test_sac_learns_pendulum(local_rt):
+    """Continuous control through the shared seams (VERDICT round-4 #5):
+    squashed-Gaussian actor + twin critics + auto temperature reach a
+    reward gate on Pendulum — the RL stack is not CartPole-shaped
+    (reference: rllib/algorithms/sac/sac.py)."""
+    from ray_tpu.rllib import SACConfig
+    algo = SACConfig(
+        num_env_runners=2, num_envs_per_runner=8, rollout_length=32,
+        lr=1e-3, learning_starts=512, updates_per_iter=256,
+        train_batch_size=256, seed=0).build()
+    first_mean = None
+    best = -1e9
+    try:
+        for _ in range(60):
+            result = algo.train()
+            mean = result["episode_return_mean"]
+            if first_mean is None and result["episodes_this_iter"]:
+                first_mean = mean
+            if mean == mean:
+                best = max(best, mean)
+            if best >= -350.0:
+                break
+    finally:
+        algo.stop()
+    assert first_mean is not None and first_mean < -700.0, \
+        f"env suspiciously easy from the start: {first_mean}"
+    assert best >= -350.0, \
+        f"SAC failed to learn: first={first_mean}, best={best}"
+
+
 def test_bc_clones_ppo_policy_from_dataset(local_rt):
     """Offline RL through the Data->Train path (VERDICT #8 done-criterion):
     record episodes from a trained PPO policy into a ray_tpu.data dataset,

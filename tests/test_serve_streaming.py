@@ -118,3 +118,58 @@ def test_openai_completions_http(stream_rt):
     assert all(c["object"] == "text_completion.chunk" for c in chunks)
     total = sum(len(c["choices"][0]["token_ids"]) for c in chunks)
     assert total == 8  # all deltas add up to max_tokens
+
+
+def test_openai_chat_completions_http(stream_rt):
+    """/v1/chat/completions with role templating + usage accounting,
+    non-streaming and SSE (VERDICT r4 #7; reference:
+    llm/_internal/serve/configs/openai_api_models.py
+    ChatCompletionRequest). Reuses the deployment from the completions
+    test via the module fixture ordering-independent re-run."""
+    from ray_tpu.llm.serve_llm import LLMServer, apply_chat_template
+
+    llm_app = serve.deployment(max_ongoing_requests=8, name="chatllm")(
+        LLMServer)
+    serve.run(llm_app.bind(engine_config={"max_batch": 2,
+                                          "total_pages": 64,
+                                          "max_seq_len": 256,
+                                          "decode_chunk": 4}))
+    port = serve.start_http_proxy()
+    messages = [{"role": "system", "content": "you are tiny"},
+                {"role": "user", "content": "hello"}]
+    n_prompt = len(apply_chat_template(messages).encode())
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps({"model": "chatllm", "messages": messages,
+                         "max_tokens": 8, "timeout_s": 240}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        body = json.loads(resp.read())
+    assert body["object"] == "chat.completion"
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+    assert body["choices"][0]["finish_reason"] == "length"
+    assert body["usage"]["prompt_tokens"] == n_prompt
+    assert body["usage"]["completion_tokens"] == 8
+    assert body["usage"]["total_tokens"] == n_prompt + 8
+
+    # streaming: role delta first, content deltas, terminal chunk with
+    # finish_reason + usage, then [DONE]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps({"model": "chatllm", "messages": messages,
+                         "max_tokens": 8, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        frames = list(_sse_frames(resp))
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    final = chunks[-1]
+    assert final["choices"][0]["finish_reason"] == "length"
+    assert final["usage"]["completion_tokens"] == 8
+    content = "".join(c["choices"][0]["delta"].get("content", "")
+                      for c in chunks)
+    assert len(content) > 0
