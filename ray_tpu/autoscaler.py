@@ -5,8 +5,11 @@ autoscaler/v2/instance_manager/instance_manager.py:29 +
 v2/scheduler.py:624 ResourceDemandScheduler; the head reports demand the
 way gcs_autoscaler_state_manager.h does): a loop polls the head for
 unserviceable lease shapes and per-node busyness, bin-packs demand onto
-configured node types, launches nodes through a pluggable NodeProvider,
-and terminates nodes idle beyond the timeout.
+a CATALOG of node types (reference:
+autoscaler/_private/resource_demand_scheduler.py:102 — a real pod fleet
+mixes CPU-only head/data hosts with several TPU slice shapes), launches
+nodes through pluggable NodeProviders, and terminates nodes idle beyond
+the timeout — each type scaling independently.
 
 ``LocalNodeProvider`` launches node daemons as local subprocesses — the
 reference's fake_multi_node provider trick (SURVEY §4 item 3) promoted to
@@ -18,6 +21,7 @@ it against a fake since this image has no cloud egress).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -72,30 +76,62 @@ class LocalNodeProvider(NodeProvider):
                 pass
 
 
-class Autoscaler:
-    """The reconcile loop. ``node_type`` is the resource shape launched
-    per scale-up (homogeneous worker pool — multi-type bin packing is a
-    straightforward extension of _nodes_needed)."""
+@dataclasses.dataclass
+class NodeTypeSpec:
+    """One entry of the node-type catalog (reference: the available_node_
+    types table resource_demand_scheduler bin-packs over,
+    resource_demand_scheduler.py:102). ``provider=None`` uses the
+    Autoscaler's default provider; slice types typically carry their own
+    TpuVmNodeProvider configured for that accelerator shape."""
 
-    def __init__(self, head_addr: str, provider: NodeProvider, *,
+    resources: Dict[str, float]
+    max_workers: int = 4
+    min_workers: int = 0
+    provider: Optional[NodeProvider] = None
+
+
+class Autoscaler:
+    """The reconcile loop over a node-type catalog.
+
+    ``node_types`` maps type name -> NodeTypeSpec; the single-type
+    ``node_type=`` shorthand wraps into a one-entry catalog. Demand
+    bin-packs across the catalog best-fit (least normalized leftover), so
+    a CPU-task backlog launches CPU hosts while a pending TPU gang bundle
+    launches exactly the slice shape that fits it.
+    """
+
+    def __init__(self, head_addr: str, provider: Optional[NodeProvider]
+                 = None, *,
                  node_type: Optional[Dict[str, float]] = None,
+                 node_types: Optional[Dict[str, NodeTypeSpec]] = None,
                  max_workers: int = 4, min_workers: int = 0,
                  idle_timeout_s: float = 10.0,
                  poll_period_s: float = 1.0):
         self.head = RpcClient(head_addr, name="autoscaler")
         self.provider = provider
-        self.node_type = node_type or {"CPU": 1.0}
-        self.max_workers = max_workers
-        self.min_workers = min_workers
+        if node_types is None:
+            node_types = {"default": NodeTypeSpec(
+                dict(node_type or {"CPU": 1.0}), max_workers=max_workers,
+                min_workers=min_workers)}
+        self.node_types = dict(node_types)
+        for name, spec in self.node_types.items():
+            if spec.provider is None and provider is None:
+                raise ValueError(f"node type {name!r} has no provider and "
+                                 f"no default was given")
         self.idle_timeout_s = idle_timeout_s
         self.poll_period_s = poll_period_s
         self._stop = threading.Event()
-        self._launched: Dict[str, Any] = {}    # node_id -> provider handle
-        self._pending: List[Any] = []          # handles not yet registered
-        self._handles: List[Any] = []          # every handle ever launched
-        self._foreign: set = set()             # nodes we did NOT launch
+        # node_id -> (type_name, provider handle)
+        self._launched: Dict[str, Any] = {}
+        self._pending: List[Any] = []     # (type_name, handle) not yet
+        #                                   registered
+        self._handles: List[Any] = []     # every handle ever launched
+        self._foreign: set = set()        # nodes we did NOT launch
         self._idle_since: Dict[str, float] = {}
         self._thread: Optional[threading.Thread] = None
+
+    def _provider_for(self, tname: str) -> NodeProvider:
+        return self.node_types[tname].provider or self.provider
 
     # ------------------------------------------------------------ lifecycle
 
@@ -111,8 +147,8 @@ class Autoscaler:
         # launch a node after the cleanup and leak a live daemon
         if self._thread is not None:
             self._thread.join(timeout=10.0)
-        for handle in self._handles:
-            self.provider.terminate_node(handle)
+        for tname, handle in self._handles:
+            self._provider_for(tname).terminate_node(handle)
         self._launched.clear()
         self._pending.clear()
         self._handles.clear()
@@ -134,23 +170,42 @@ class Autoscaler:
         except RpcError:
             return
         self._adopt_registered(state["nodes"])
-        n_live = len(self._launched) + len(self._pending)
-        need = self._nodes_needed(state["demand"])
-        up = min(need, self.max_workers - n_live)
-        for _ in range(max(0, up)):
-            if self._stop.is_set():
-                return
-            logger.info("autoscaler: launching node %s", self.node_type)
-            handle = self.provider.create_node(self.node_type)
-            self._pending.append(handle)
-            self._handles.append(handle)
-        if need == 0:
-            # Never shrink while SERVICEABLE shapes are pending — a node
-            # idle between two task waves would flap. Demand this
-            # node_type can never satisfy (an infeasible gang bundle)
-            # must NOT block drain forever, hence need==0 rather than
-            # raw-demand-empty.
-            self._scale_down(state["nodes"])
+        live = self._live_counts()
+        need = self._nodes_needed(state["demand"], live)
+        for tname, count in need.items():
+            spec = self.node_types[tname]
+            up = min(count, spec.max_workers - live.get(tname, 0))
+            for _ in range(max(0, up)):
+                if self._stop.is_set():
+                    return
+                logger.info("autoscaler: launching %s node %s", tname,
+                            spec.resources)
+                handle = self._provider_for(tname).create_node(
+                    dict(spec.resources))
+                self._pending.append((tname, handle))
+                self._handles.append((tname, handle))
+        # Busy nodes reset their idle clock regardless of which types
+        # are draining this pass — a stale timestamp from an earlier
+        # idle spell would otherwise terminate a node the instant its
+        # NEXT idle spell begins
+        for n in state["nodes"]:
+            if n.get("busy"):
+                self._idle_since.pop(n["node_id"], None)
+        # Per-type drain: a type with no serviceable pending demand
+        # shrinks even while OTHER types are scaling up (an idle TPU
+        # slice must not be kept hot by a CPU-task backlog). Demand a
+        # type can never satisfy (an infeasible gang bundle) must NOT
+        # block its drain forever, hence need==0 rather than
+        # raw-demand-empty.
+        quiet = [t for t in self.node_types if need.get(t, 0) == 0]
+        if quiet:
+            self._scale_down(state["nodes"], quiet)
+
+    def _live_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for tname, _ in list(self._launched.values()) + self._pending:
+            counts[tname] = counts.get(tname, 0) + 1
+        return counts
 
     def _adopt_registered(self, nodes: List[dict]) -> None:
         """Move pending launches into the launched map once their node
@@ -160,76 +215,111 @@ class Autoscaler:
         adopted and later idle-terminated (advisor r2)."""
         known = {n["node_id"] for n in nodes}
         still = []
-        for handle in self._pending:
+        for tname, handle in self._pending:
             nid = getattr(handle, "rtpu_node_id", None)
             if nid is not None and nid in known:
-                self._launched[nid] = handle
+                self._launched[nid] = (tname, handle)
             elif getattr(handle, "poll", lambda: None)() is not None:
                 logger.warning("autoscaler: launched node died pre-register")
             else:
-                still.append(handle)
+                still.append((tname, handle))
         self._pending = still
         # everything not ours is someone else's node (the static head
         # node, manual joins) — never adopt or terminate those
         self._foreign |= known - set(self._launched)
 
-    def _nodes_needed(self, demand: List[Dict[str, float]]) -> int:
-        """Bin-pack pending shapes onto copies of node_type (reference:
-        resource_demand_scheduler bin packing, simplified to one type)."""
+    def _nodes_needed(self, demand: List[Dict[str, float]],
+                      live: Optional[Dict[str, int]] = None
+                      ) -> Dict[str, int]:
+        """Bin-pack pending shapes across the node-type catalog
+        (reference: resource_demand_scheduler.py:102): shapes first fill
+        bins already opened this pass; a shape that fits nowhere opens a
+        new bin of the BEST-FIT type (least normalized leftover — a 1-CPU
+        task opens a CPU host, not a TPU slice), respecting each type's
+        max_workers against live+planned counts."""
+        need: Dict[str, int] = {}
         if not demand:
-            return 0
-        bins: List[Dict[str, float]] = []
+            return need
+        live = dict(live or {})
+        bins: List[Any] = []   # (tname, remaining resources)
         for shape in demand:
-            if any(v > self.node_type.get(k, 0.0)
-                   for k, v in shape.items()):
-                continue  # this node type can never fit it
-            for b in bins:
+            placed = False
+            for _, b in bins:
                 if all(b.get(k, 0.0) >= v for k, v in shape.items()):
                     for k, v in shape.items():
                         b[k] = b.get(k, 0.0) - v
+                    placed = True
                     break
-            else:
-                fresh = dict(self.node_type)
-                for k, v in shape.items():
-                    fresh[k] = fresh.get(k, 0.0) - v
-                bins.append(fresh)
-        return len(bins)
-
-    def _scale_down(self, nodes: List[dict]) -> None:
-        now = time.monotonic()
-        alive_mine = [n for n in nodes
-                      if n["alive"] and n["node_id"] in self._launched]
-        removable = len(alive_mine) - self.min_workers
-        for n in alive_mine:
-            nid = n["node_id"]
-            if n["busy"]:
-                self._idle_since.pop(nid, None)
+            if placed:
                 continue
-            first_idle = self._idle_since.setdefault(nid, now)
-            if removable > 0 and now - first_idle >= self.idle_timeout_s:
-                logger.info("autoscaler: terminating idle node %s", nid[:12])
-                handle = self._launched.pop(nid)
-                self._idle_since.pop(nid, None)
-                # drain via the node's own shutdown RPC, addressed by
-                # node_id (handles and node ids were paired by launch
-                # identity, but the daemon exits cleanest by RPC)...
-                drain = RpcClient(n["address"], name="asc-drain")
-                try:
-                    drain.call("shutdown", {}, timeout=5.0)
-                except RpcError:
-                    pass  # already dead
-                finally:
-                    drain.close()
-                # ...then release the underlying machine through the
-                # provider — for a cloud provider this is the API call
-                # that actually stops billing (a local Popen terminate is
-                # an idempotent no-op after the RPC shutdown)
-                try:
-                    self.provider.terminate_node(handle)
-                except Exception:  # noqa: BLE001
-                    logger.exception("terminate_node failed for %s", nid[:12])
-                self._handles = [h for h in self._handles if h is not handle]
-                removable -= 1
+            best = None
+            best_score = None
+            for tname, spec in self.node_types.items():
+                res = spec.resources
+                if any(v > res.get(k, 0.0) for k, v in shape.items()):
+                    continue  # can never fit
+                if live.get(tname, 0) + need.get(tname, 0) >= \
+                        spec.max_workers:
+                    continue  # type at capacity
+                # normalized leftover: fraction of the node left unused
+                score = sum(1.0 - shape.get(k, 0.0) / v
+                            for k, v in res.items() if v > 0)
+                if best_score is None or score < best_score:
+                    best, best_score = tname, score
+            if best is None:
+                continue  # infeasible everywhere (or everything capped)
+            fresh = dict(self.node_types[best].resources)
+            for k, v in shape.items():
+                fresh[k] = fresh.get(k, 0.0) - v
+            bins.append((best, fresh))
+            need[best] = need.get(best, 0) + 1
+        return need
+
+    def _scale_down(self, nodes: List[dict],
+                    types: List[str]) -> None:
+        now = time.monotonic()
+        by_type: Dict[str, List[dict]] = {t: [] for t in types}
+        for n in nodes:
+            entry = self._launched.get(n["node_id"])
+            if n["alive"] and entry is not None and entry[0] in by_type:
+                by_type[entry[0]].append(n)
+        for tname, alive_mine in by_type.items():
+            removable = len(alive_mine) - \
+                self.node_types[tname].min_workers
+            for n in alive_mine:
+                nid = n["node_id"]
+                if n["busy"]:
+                    self._idle_since.pop(nid, None)
+                    continue
+                first_idle = self._idle_since.setdefault(nid, now)
+                if removable > 0 and \
+                        now - first_idle >= self.idle_timeout_s:
+                    logger.info("autoscaler: terminating idle %s node %s",
+                                tname, nid[:12])
+                    _, handle = self._launched.pop(nid)
+                    self._idle_since.pop(nid, None)
+                    # drain via the node's own shutdown RPC, addressed by
+                    # node_id (handles and node ids were paired by launch
+                    # identity, but the daemon exits cleanest by RPC)...
+                    drain = RpcClient(n["address"], name="asc-drain")
+                    try:
+                        drain.call("shutdown", {}, timeout=5.0)
+                    except RpcError:
+                        pass  # already dead
+                    finally:
+                        drain.close()
+                    # ...then release the underlying machine through the
+                    # provider — for a cloud provider this is the API call
+                    # that actually stops billing (a local Popen terminate
+                    # is an idempotent no-op after the RPC shutdown)
+                    try:
+                        self._provider_for(tname).terminate_node(handle)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("terminate_node failed for %s",
+                                         nid[:12])
+                    self._handles = [(t, h) for t, h in self._handles
+                                     if h is not handle]
+                    removable -= 1
 
 
 class AutoscalingCluster:

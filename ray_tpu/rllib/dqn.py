@@ -143,15 +143,20 @@ class DQN(TrainerBase):
         returns: List[float] = []
         for b in batches:
             T, B = b["rewards"].shape
-            # trajectory -> transitions: s'[t] = s[t+1] (the auto-reset
-            # boundary is masked by dones in the TD target, so the reset
-            # obs standing in for the terminal obs is harmless)
+            # trajectory -> transitions: s'[t] = s[t+1], except at
+            # boundaries where the true pre-reset obs stands in (the
+            # auto-reset obs belongs to the NEXT episode); only true
+            # terminations mask the TD bootstrap — a 500-step CartPole
+            # truncation bootstraps through (gym terminated/truncated)
             next_obs = np.concatenate([b["obs"][1:], b["last_obs"][None]])
+            next_obs = np.where(b["dones"][..., None], b["final_obs"],
+                                next_obs)
+            terminal = b["dones"] & ~b["truncated"]
             self.buffer.add_batch(
                 b["obs"].reshape(T * B, -1),
                 b["actions"].reshape(T * B),
                 b["rewards"].reshape(T * B),
-                b["dones"].reshape(T * B),
+                terminal.reshape(T * B),
                 next_obs.reshape(T * B, -1))
             returns.extend(b["episode_returns"].tolist())
         metrics: Dict[str, float] = {}

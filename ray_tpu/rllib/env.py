@@ -32,7 +32,12 @@ class VectorEnv:
              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict[str, Any]]:
         """actions [B] (discrete) or [B, action_dim] (continuous) ->
         (obs [B, D], rewards [B], dones [B], info).
-        Done envs auto-reset; obs is the NEW episode's first obs."""
+        Done envs auto-reset; obs is the NEW episode's first obs. info
+        carries the boundary facts the auto-reset hides from learners:
+        ``truncated`` [B] (done by TIME LIMIT, not failure — off-policy
+        TD targets must bootstrap THROUGH these, gym's terminated/
+        truncated split) and ``final_obs`` [B, D] (the pre-reset
+        observation, the true s' for boundary transitions)."""
         raise NotImplementedError
 
 
@@ -89,10 +94,12 @@ class CartPoleVectorEnv(VectorEnv):
         self._steps += 1
         self._ret += 1.0
 
-        dones = ((np.abs(x) > self.X_LIMIT)
-                 | (np.abs(th) > self.THETA_LIMIT)
-                 | (self._steps >= self.MAX_STEPS))
+        failed = ((np.abs(x) > self.X_LIMIT)
+                  | (np.abs(th) > self.THETA_LIMIT))
+        truncated = (~failed) & (self._steps >= self.MAX_STEPS)
+        dones = failed | truncated
         rewards = np.ones(self.num_envs, np.float32)
+        final_obs = self._state.astype(np.float32)
         if dones.any():
             idx = np.flatnonzero(dones)
             self.episode_returns.extend(self._ret[idx].tolist())
@@ -101,7 +108,9 @@ class CartPoleVectorEnv(VectorEnv):
             self._steps[idx] = 0
             self._ret[idx] = 0
         return (self._state.astype(np.float32), rewards,
-                dones.astype(np.bool_), {})
+                dones.astype(np.bool_),
+                {"truncated": truncated.astype(np.bool_),
+                 "final_obs": final_obs})
 
 
 class PendulumVectorEnv(VectorEnv):
@@ -163,6 +172,7 @@ class PendulumVectorEnv(VectorEnv):
         rewards = (-cost).astype(np.float32)
         self._ret += rewards
         dones = self._steps >= self.MAX_STEPS
+        final_obs = self._obs()
         if dones.any():
             idx = np.flatnonzero(dones)
             self.episode_returns.extend(self._ret[idx].tolist())
@@ -170,7 +180,10 @@ class PendulumVectorEnv(VectorEnv):
             self._thdot[idx] = self._rng.uniform(-1.0, 1.0, len(idx))
             self._steps[idx] = 0
             self._ret[idx] = 0
-        return self._obs(), rewards, dones.astype(np.bool_), {}
+        # every Pendulum done is a TIME LIMIT, never a failure state
+        return (self._obs(), rewards, dones.astype(np.bool_),
+                {"truncated": dones.astype(np.bool_),
+                 "final_obs": final_obs})
 
 
 ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv,

@@ -92,6 +92,9 @@ class EnvRunner:
             "values": np.zeros((T, B), np.float32),
             "rewards": np.zeros((T, B), np.float32),
             "dones": np.zeros((T, B), np.bool_),
+            "truncated": np.zeros((T, B), np.bool_),
+            "final_obs": np.zeros((T, B, self.env.observation_dim),
+                                  np.float32),
         }
         self.env.episode_returns.clear()
         for t in range(T):
@@ -103,9 +106,13 @@ class EnvRunner:
             out["actions"][t] = actions
             out["logp"][t] = np.asarray(logp)
             out["values"][t] = np.asarray(values)
-            self.obs, rewards, dones, _ = self.env.step(actions)
+            self.obs, rewards, dones, info = self.env.step(actions)
             out["rewards"][t] = rewards
             out["dones"][t] = dones
+            if "truncated" in info:
+                out["truncated"][t] = info["truncated"]
+            if "final_obs" in info:
+                out["final_obs"][t] = info["final_obs"]
         _, _, last_value = self._sample(self.params, self.obs, self._key,
                                         self.epsilon)
         out["last_value"] = np.asarray(last_value)

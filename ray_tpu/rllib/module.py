@@ -16,15 +16,31 @@ import jax.numpy as jnp
 Params = Dict[str, jnp.ndarray]
 
 
-def init_module(key: jax.Array, obs_dim: int, num_actions: int,
-                hidden: Tuple[int, ...] = (64, 64)) -> Params:
-    sizes = (obs_dim,) + hidden
+def _init_torso(keys, sizes) -> Params:
+    """Kaiming-init tanh MLP torso: w{i}/b{i} per hidden layer (one
+    definition shared by the discrete policy/value module and the SAC
+    actor/critic nets)."""
     params: Params = {}
-    keys = jax.random.split(key, len(hidden) + 2)
-    for i in range(len(hidden)):
+    for i in range(len(sizes) - 1):
         params[f"w{i}"] = jax.random.normal(
             keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5
         params[f"b{i}"] = jnp.zeros(sizes[i + 1])
+    return params
+
+
+def _torso_forward(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    # hidden-layer count from the key names (static under jit)
+    n = sum(1 for k in params if k[0] == "w" and k[1:].isdigit())
+    for i in range(n):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    return x
+
+
+def init_module(key: jax.Array, obs_dim: int, num_actions: int,
+                hidden: Tuple[int, ...] = (64, 64)) -> Params:
+    sizes = (obs_dim,) + hidden
+    keys = jax.random.split(key, len(hidden) + 2)
+    params = _init_torso(keys, sizes)
     params["w_pi"] = jax.random.normal(
         keys[-2], (sizes[-1], num_actions)) * 0.01
     params["b_pi"] = jnp.zeros(num_actions)
@@ -36,11 +52,7 @@ def init_module(key: jax.Array, obs_dim: int, num_actions: int,
 def forward(params: Params, obs: jnp.ndarray
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """obs [B, D] -> (logits [B, A], value [B])."""
-    h = obs
-    # hidden-layer count from the key names (static under jit)
-    n = sum(1 for k in params if k[0] == "w" and k[1:].isdigit())
-    for i in range(n):
-        h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+    h = _torso_forward(params, obs)
     logits = h @ params["w_pi"] + params["b_pi"]
     value = (h @ params["w_v"] + params["b_v"])[:, 0]
     return logits, value
@@ -64,12 +76,8 @@ LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
 
 
 def _init_mlp(key, sizes, out_dim, out_scale=0.01) -> Params:
-    params: Params = {}
     keys = jax.random.split(key, len(sizes))
-    for i in range(len(sizes) - 1):
-        params[f"w{i}"] = jax.random.normal(
-            keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5
-        params[f"b{i}"] = jnp.zeros(sizes[i + 1])
+    params = _init_torso(keys, sizes)
     params["w_out"] = jax.random.normal(
         keys[-1], (sizes[-1], out_dim)) * out_scale
     params["b_out"] = jnp.zeros(out_dim)
@@ -77,9 +85,7 @@ def _init_mlp(key, sizes, out_dim, out_scale=0.01) -> Params:
 
 
 def _mlp_forward(params: Params, x: jnp.ndarray) -> jnp.ndarray:
-    n = sum(1 for k in params if k[0] == "w" and k[1:].isdigit())
-    for i in range(n):
-        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    x = _torso_forward(params, x)
     return x @ params["w_out"] + params["b_out"]
 
 
@@ -119,9 +125,12 @@ def sample_squashed(actor: Params, obs: jnp.ndarray, key: jax.Array,
     logp_gauss = (-0.5 * ((pre - mean) / std) ** 2 - log_std
                   - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
     tanh = jnp.tanh(pre)
-    # log |d tanh/d pre| = log(1 - tanh^2); the numerically-stable form
+    # log |d tanh/d pre| = log(1 - tanh^2) (stable form), plus the
+    # scale's change-of-variables: the returned action is
+    # action_scale * tanh(pre), so its density divides by the scale
     logp = logp_gauss - (2 * (jnp.log(2.0) - pre
                               - jax.nn.softplus(-2 * pre))).sum(-1)
+    logp = logp - mean.shape[-1] * jnp.log(action_scale)
     return action_scale * tanh, logp
 
 

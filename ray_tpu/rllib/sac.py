@@ -213,12 +213,20 @@ class SAC(TrainerBase):
         returns: List[float] = []
         for b in batches:
             T, B = b["rewards"].shape
+            # s' at a boundary is the PRE-reset obs (auto-reset hid it),
+            # and only true failures mask the bootstrap — a time-limit
+            # truncation bootstraps through (gym terminated/truncated
+            # split; on Pendulum EVERY done is a truncation, so masking
+            # them would teach the critic V=0 at arbitrary states)
             next_obs = np.concatenate([b["obs"][1:], b["last_obs"][None]])
+            next_obs = np.where(b["dones"][..., None], b["final_obs"],
+                                next_obs)
+            terminal = b["dones"] & ~b["truncated"]
             self.buffer.add_batch(
                 b["obs"].reshape(T * B, -1),
                 b["actions"].reshape(T * B, -1),
                 b["rewards"].reshape(T * B),
-                b["dones"].reshape(T * B),
+                terminal.reshape(T * B),
                 next_obs.reshape(T * B, -1))
             returns.extend(b["episode_returns"].tolist())
         metrics: Dict[str, float] = {}

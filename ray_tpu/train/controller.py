@@ -96,10 +96,6 @@ class TrainController:
         if isinstance(self.scaling_policy, FixedScalingPolicy):
             return  # fixed-size runs never grow; skip the poll thread
         poll = max(0.2, self.scaling.grow_poll_s)
-        # min-dwell: this group must run a while before a grow may
-        # interrupt it; combined with any failure-restart cooldown
-        dwell_until = time.monotonic() + max(
-            0.0, self.scaling.grow_min_dwell_s)
 
         def _mon():
             # Wait until every worker is PLACED before judging capacity:
@@ -111,6 +107,11 @@ class TrainController:
                              for w in group.workers], timeout=300)
             except Exception:  # noqa: BLE001 — group failing; that path
                 return         # is handled by the failure policy
+            # min-dwell clock starts AFTER placement: slow cold
+            # scheduling must not consume the window before the group
+            # has run a single step
+            dwell_until = time.monotonic() + max(
+                0.0, self.scaling.grow_min_dwell_s)
             while not stop.wait(poll):
                 if time.monotonic() < max(dwell_until,
                                           self._grow_allowed_at):
