@@ -81,15 +81,37 @@ constexpr uint64_t RT_FAST_BIT = 1ull << 62;
 constexpr uint64_t RT_REPLY_BIT = 1ull << 63;
 
 enum FastOp : uint8_t {
-  FOP_PUT = 1,   // flags bit0 = overwrite; status = 1 if newly created
-  FOP_GET = 2,   // status = 1 hit (val follows), 0 miss
-  FOP_DEL = 3,   // status = 1 if the key existed
-  FOP_PING = 4,  // status = 1, val = u64 incarnation
+  FOP_PUT = 1,        // flags bit0 = overwrite; status = 1 if newly created
+  FOP_GET = 2,        // status = 1 hit (val follows), 0 miss
+  FOP_DEL = 3,        // status = 1 if the key existed
+  FOP_PING = 4,       // status = 1, val = u64 incarnation
+  FOP_LEASE_ACQ = 5,  // key = u64 shape sig; status 1 + grant blob, 0 miss
+  FOP_LEASE_REL = 6,  // key = u64 lease key; status 1 re-pooled, 0 unknown
+};
+
+// Native lease grant pool (role of the reference raylet's worker-lease
+// grant loop, src/ray/raylet/node_manager.cc:1908 HandleRequestWorkerLease
+// — redesigned: Python placement policy pre-stocks fully-formed grants per
+// resource-shape signature; acquire/release in the steady state are served
+// entirely inside this event loop, no Python, no pickle, no GIL).
+struct FastLease {
+  struct Held {
+    uint64_t conn_id;
+    uint64_t sig;
+    std::string grant;
+  };
+  // sig -> FIFO of (lease_key, grant blob) ready to hand out
+  std::unordered_map<uint64_t,
+                     std::deque<std::pair<uint64_t, std::string>>> pools;
+  // lease_key -> holder (reclaimed by Python on conn disconnect)
+  std::unordered_map<uint64_t, Held> held;
+  uint64_t hits = 0, misses = 0, releases = 0;
 };
 
 struct FastKV {
-  std::mutex mu;
+  std::mutex mu;  // guards kv AND lease (one lock: ops touch one or other)
   std::unordered_map<std::string, std::string> kv;
+  FastLease lease;
   uint64_t incarnation = 0;
   std::atomic<uint64_t> version{0};  // bumped on mutation (persist-dirty)
 };
@@ -185,6 +207,18 @@ struct Loop {
 void set_nodelay(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// rt_send's latency fast path (inline writev when the conn is quiet) can
+// be disabled to force poller-side batched flushing — A/B knob for hosts
+// where the sender-side syscall + poller mutex contention costs more than
+// the wakeup it saves (RTPU_SEND_INLINE=0).
+bool inline_send_enabled() {
+  static const bool on = [] {
+    const char* v = getenv("RTPU_SEND_INLINE");
+    return v == nullptr || v[0] != '0';
+  }();
+  return on;
 }
 
 char* dup_bytes(const char* p, size_t n) {
@@ -363,6 +397,42 @@ void handle_fast(Loop* L, Conn* c, uint64_t req_id, char* body,
         case FOP_PING: {
           status = 1;
           out.assign(reinterpret_cast<const char*>(&kv->incarnation), 8);
+          break;
+        }
+        case FOP_LEASE_ACQ: {
+          if (klen == 8) {
+            uint64_t sig;
+            memcpy(&sig, key, 8);
+            FastLease& fl = kv->lease;
+            auto pit = fl.pools.find(sig);
+            if (pit != fl.pools.end() && !pit->second.empty()) {
+              auto& front = pit->second.front();
+              uint64_t lkey = front.first;
+              out = std::move(front.second);
+              pit->second.pop_front();
+              fl.held[lkey] = FastLease::Held{c->id, sig, out};
+              fl.hits++;
+              status = 1;
+            } else {
+              fl.misses++;
+            }
+          }
+          break;
+        }
+        case FOP_LEASE_REL: {
+          if (klen == 8) {
+            uint64_t lkey;
+            memcpy(&lkey, key, 8);
+            FastLease& fl = kv->lease;
+            auto hit = fl.held.find(lkey);
+            if (hit != fl.held.end()) {
+              fl.pools[hit->second.sig].emplace_back(
+                  lkey, std::move(hit->second.grant));
+              fl.held.erase(hit);
+              fl.releases++;
+              status = 1;
+            }
+          }
           break;
         }
         default:
@@ -810,6 +880,142 @@ int64_t rt_fastpath_keys(void* loop, uint64_t listener_id,
 
 void rt_buf_free(char* p) { free(p); }
 
+// ---- fast-path lease pool (host-side policy APIs; see FastLease above) ----
+
+// deposit one ready grant into the pool for `sig`. 0 ok, -1 no fastpath.
+int rt_fastlease_stock(void* loop, uint64_t listener_id, uint64_t sig,
+                       uint64_t lease_key, const char* grant, uint64_t glen) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  kv->lease.pools[sig].emplace_back(lease_key, std::string(grant, glen));
+  return 0;
+}
+
+// pop one pooled (un-held) grant back out, e.g. for idle drain.
+// 1 popped (out_key/out/out_len set, free out via rt_buf_free), 0 empty,
+// -1 no fastpath.
+int rt_fastlease_unstock(void* loop, uint64_t listener_id, uint64_t sig,
+                         uint64_t* out_key, char** out, uint64_t* out_len) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  auto pit = kv->lease.pools.find(sig);
+  if (pit == kv->lease.pools.end() || pit->second.empty()) return 0;
+  auto& back = pit->second.back();  // LIFO: keep the hottest grants pooled
+  *out_key = back.first;
+  *out = dup_bytes(back.second.data(), back.second.size());
+  *out_len = back.second.size();
+  pit->second.pop_back();
+  return 1;
+}
+
+// drop lease_key wherever it is (worker died / node lost):
+// 2 = removed from held, 1 = removed from a pool, 0 = unknown, -1 = no fp.
+int rt_fastlease_invalidate(void* loop, uint64_t listener_id,
+                            uint64_t lease_key) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  if (kv->lease.held.erase(lease_key)) return 2;
+  for (auto& p : kv->lease.pools) {
+    for (auto it = p.second.begin(); it != p.second.end(); ++it) {
+      if (it->first == lease_key) {
+        p.second.erase(it);
+        return 1;
+      }
+    }
+  }
+  return 0;
+}
+
+// reclaim every grant held by a disconnected conn. Out buffer:
+// (u64 lease_key, u64 sig, u64 blen, blob)* — free via rt_buf_free.
+// Returns reclaimed count, -1 if no fastpath.
+int64_t rt_fastlease_reclaim_conn(void* loop, uint64_t listener_id,
+                                  uint64_t conn_id, char** out,
+                                  uint64_t* out_len) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  size_t total = 0;
+  int64_t n = 0;
+  for (auto& e : kv->lease.held) {
+    if (e.second.conn_id == conn_id) {
+      total += 24 + e.second.grant.size();
+      n++;
+    }
+  }
+  char* buf = static_cast<char*>(malloc(total ? total : 1));
+  char* p = buf;
+  for (auto it = kv->lease.held.begin(); it != kv->lease.held.end();) {
+    if (it->second.conn_id == conn_id) {
+      uint64_t lkey = it->first, sig = it->second.sig,
+               blen = it->second.grant.size();
+      memcpy(p, &lkey, 8);
+      memcpy(p + 8, &sig, 8);
+      memcpy(p + 16, &blen, 8);
+      memcpy(p + 24, it->second.grant.data(), blen);
+      p += 24 + blen;
+      it = kv->lease.held.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  *out = buf;
+  *out_len = total;
+  return n;
+}
+
+// pooled (un-held, grantable) entries: (u64 sig, u64 lease_key)* — free
+// via rt_buf_free. Returns count, -1 if no fastpath. Lets Python report
+// pooled capacity as AVAILABLE (it is reclaimable in one drain call).
+int64_t rt_fastlease_pooled(void* loop, uint64_t listener_id, char** out,
+                            uint64_t* out_len) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  size_t n = 0;
+  for (auto& p : kv->lease.pools) n += p.second.size();
+  char* buf = static_cast<char*>(malloc(n ? n * 16 : 1));
+  char* w = buf;
+  for (auto& p : kv->lease.pools) {
+    for (auto& e : p.second) {
+      memcpy(w, &p.first, 8);
+      memcpy(w + 8, &e.first, 8);
+      w += 16;
+    }
+  }
+  *out = buf;
+  *out_len = n * 16;
+  return static_cast<int64_t>(n);
+}
+
+// out4 = {hits, misses, pooled_total, held_total}. 0 ok, -1 no fastpath.
+int rt_fastlease_stats(void* loop, uint64_t listener_id, uint64_t* out4) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  uint64_t pooled = 0;
+  for (auto& p : kv->lease.pools) pooled += p.second.size();
+  out4[0] = kv->lease.hits;
+  out4[1] = kv->lease.misses;
+  out4[2] = pooled;
+  out4[3] = kv->lease.held.size();
+  return 0;
+}
+
+// pool depth for one sig. -1 if no fastpath.
+int64_t rt_fastlease_depth(void* loop, uint64_t listener_id, uint64_t sig) {
+  auto kv = find_fastkv(static_cast<Loop*>(loop), listener_id);
+  if (!kv) return -1;
+  std::lock_guard<std::mutex> g(kv->mu);
+  auto pit = kv->lease.pools.find(sig);
+  return pit == kv->lease.pools.end()
+             ? 0
+             : static_cast<int64_t>(pit->second.size());
+}
+
 // resolve + start a nonblocking connect; the poller completes it.
 // Returns conn id (>0), or 0 if the address didn't resolve.
 uint64_t rt_connect(void* loop, const char* host, int port) {
@@ -909,7 +1115,8 @@ int rt_send(void* loop, uint64_t conn_id, uint64_t req_id, const char* data,
       static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
   bool bursting = now_ns - c->last_send_ns < 200000;
   c->last_send_ns = now_ns;
-  if (was_empty && !bursting && !c->connecting && c->fd >= 0) {
+  if (was_empty && !bursting && !c->connecting && c->fd >= 0 &&
+      inline_send_enabled()) {
     // latency fast-path: try the write inline; leftovers flushed on
     // EPOLLOUT by the poller
     iovec iov{buf, 16 + static_cast<size_t>(len)};

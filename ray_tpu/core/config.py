@@ -39,8 +39,12 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     # --- scheduling ---
     "lease_idle_linger_s": (float, 0.5, "idle lease kept this long for reuse before release"),
     "max_pending_lease_requests": (int, 10, "lease requests in flight per resource shape (reference: max_pending_lease_requests_per_scheduling_category)"),
+    "fast_lease_pool_target": (int, 4, "grants pre-stocked per resource shape in the head's native lease pool (0 disables the C fast path); kept shallow — instant grants bypass the RPC latency that naturally throttles worker fan-out"),
+    "fast_lease_client": (bool, True, "clients try the native lease pool before the Python request_lease RPC (A/B toggle)"),
+    "fast_lease_idle_drain_s": (float, 3.0, "pooled fast-lease grants idle longer than this drain back to the cluster (short: the pool refills in one RPC round-trip on the next burst, and held capacity must not mask node idleness from the autoscaler)"),
     "task_push_batch": (int, 32, "max tasks coalesced into one push frame per lease/actor"),
     "task_burst_defer": (bool, True, "defer bursty normal-task submits to the shared flusher (batch coalescing)"),
+    "task_combined_push": (bool, True, "ship multi-task batches as ONE combined frame with one combined reply (vs per-task frames)"),
     "worker_pool_prestart": (int, 0, "workers prestarted per node"),
     "worker_pool_max": (int, 64, "max workers per node"),
     "worker_idle_timeout_s": (float, 300.0, "idle worker reap time"),
@@ -55,6 +59,8 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "memory_monitor_refresh_ms": (int, 250, "node RSS poll period; 0 disables the memory monitor (reference: memory_monitor_refresh_ms)"),
     "memory_usage_threshold": (float, 0.95, "node memory fraction above which the OOM killer picks a victim (reference: memory_usage_threshold)"),
     "worker_memory_limit_bytes": (int, 0, "per-worker RSS cap, 0 = none; over-limit workers are OOM-killed"),
+    "worker_cgroup": (bool, True, "isolate workers in per-worker cgroup-v2 leaves (best-effort; no-op without a writable unified hierarchy)"),
+    "cgroup_root": (str, "/sys/fs/cgroup", "cgroup-v2 mount point (injectable for tests)"),
     "infeasible_grace_s": (float, 30.0, "wait for autoscaling before failing infeasible resource shapes"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
     "max_lineage_bytes": (int, 64 * 1024**2, "lineage cache cap per owner"),
@@ -94,6 +100,15 @@ class _Config:
             return self.__dict__["_values"][name]
         except KeyError:
             raise AttributeError(name) from None
+
+    def apply_env_overrides(self) -> None:
+        """Re-read RTPU_* from this process's environment ON TOP of any
+        applied table — lets a spawned worker's runtime_env env_vars
+        override the cluster-propagated config for that worker only."""
+        for name, (typ, _default, _help) in _CONFIG_DEFS.items():
+            env = os.environ.get(_ENV_PREFIX + name)
+            if env is not None:
+                self._values[name] = _parse(typ, env)
 
     def to_json(self) -> str:
         return json.dumps(self._values)

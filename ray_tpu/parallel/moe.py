@@ -59,9 +59,12 @@ def _routing(params: Params, x: jnp.ndarray, top_k: int):
     return topk_idx, topk_w
 
 
-def _expert_ffn(w_in, w_out, h):
-    """h [..., d] through one expert (silu MLP)."""
-    return jax.nn.silu(h @ w_in) @ w_out
+def _expert_ffn(w_in, w_out, h, w_gate=None):
+    """h [..., d] through one expert: silu MLP, or gated SwiGLU when the
+    params carry a w_gate (Mixtral's 3-matrix expert)."""
+    if w_gate is None:
+        return jax.nn.silu(h @ w_in) @ w_out
+    return (jax.nn.silu(h @ w_gate) * (h @ w_in)) @ w_out
 
 
 def moe_ffn(params: Params, x: jnp.ndarray, *, top_k: int = 2
@@ -69,6 +72,7 @@ def moe_ffn(params: Params, x: jnp.ndarray, *, top_k: int = 2
     """Dense reference: every token × its top-k experts, no capacity."""
     T, d = x.shape
     E = params["router"].shape[1]
+    gated = "w_gate" in params
     topk_idx, topk_w = _routing(params, x, top_k)
     # [T, E] combined weight per expert
     w_full = jnp.zeros((T, E), jnp.float32)
@@ -76,7 +80,9 @@ def moe_ffn(params: Params, x: jnp.ndarray, *, top_k: int = 2
     out = jnp.zeros_like(x, dtype=jnp.float32)
     for e in range(E):  # static unroll: E is small, shapes stay static
         y = _expert_ffn(params["w_in"][e].astype(x.dtype),
-                        params["w_out"][e].astype(x.dtype), x)
+                        params["w_out"][e].astype(x.dtype), x,
+                        params["w_gate"][e].astype(x.dtype) if gated
+                        else None)
         out = out + w_full[:, e:e + 1] * y.astype(jnp.float32)
     return out.astype(x.dtype)
 
@@ -113,8 +119,13 @@ def _moe_shard(params: Params, x: jnp.ndarray, *, top_k: int,
                           tiled=False)
     # process: [e_local, ep*c, d] through local experts
     disp = disp.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, d)
-    out = jax.vmap(_expert_ffn)(params["w_in"].astype(x.dtype),
-                                params["w_out"].astype(x.dtype), disp)
+    if "w_gate" in params:
+        out = jax.vmap(_expert_ffn)(params["w_in"].astype(x.dtype),
+                                    params["w_out"].astype(x.dtype), disp,
+                                    params["w_gate"].astype(x.dtype))
+    else:
+        out = jax.vmap(_expert_ffn)(params["w_in"].astype(x.dtype),
+                                    params["w_out"].astype(x.dtype), disp)
     # return trip
     out = out.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
     out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
@@ -151,6 +162,8 @@ def moe_ffn_sharded(params: Params, x: jnp.ndarray, mesh, *,
     pspec = {"router": P(None, None),
              "w_in": P(axis_name, None, None),
              "w_out": P(axis_name, None, None)}
+    if "w_gate" in params:
+        pspec["w_gate"] = P(axis_name, None, None)
     fn = shard_map_compat(
         functools.partial(_moe_shard, top_k=top_k, capacity=capacity,
                           axis_name=axis_name),

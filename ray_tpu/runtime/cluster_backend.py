@@ -53,16 +53,19 @@ from ray_tpu.runtime.protocol import (ClientPool, RpcClient, RpcError,
 
 class _Lease:
     __slots__ = ("lease_id", "worker_addr", "worker_id", "node_addr",
-                 "busy", "idle_since")
+                 "busy", "idle_since", "fast_key")
 
     def __init__(self, lease_id: str, worker_addr: str, worker_id: bytes,
-                 node_addr: str = ""):
+                 node_addr: str = "", fast_key: Optional[int] = None):
         self.lease_id = lease_id
         self.worker_addr = worker_addr
         self.worker_id = worker_id
         self.node_addr = node_addr
         self.busy = False
         self.idle_since = time.monotonic()
+        # set when granted by the head's native lease pool: release can
+        # then be a single fast frame served inside the head's C loop
+        self.fast_key = fast_key
 
 
 class _PendingTask:
@@ -112,6 +115,7 @@ class _TaskSubmitter:
         self._infeasible_since: Optional[float] = None
         self.lock = threading.Lock()
         self._last_submit = 0.0
+        self._sig: Optional[int] = None  # lazy wire.lease_sig(resources)
 
     # -- public --
 
@@ -119,10 +123,13 @@ class _TaskSubmitter:
         now = time.monotonic()
         with self.lock:
             self.pending.append(_PendingTask(payload, spec, pins))
-            # burst deferral (same as the actor submitter): back-to-back
-            # submits let pending ACCUMULATE for the shared flusher, whose
-            # _pump then ships proportional batches; isolated submits pump
-            # inline for latency
+            # Burst deferral: back-to-back submits (<200us apart) let
+            # pending ACCUMULATE for the shared flusher, whose _pump then
+            # ships proportional combined batches; isolated submits pump
+            # inline for latency. Timing-window only: gating on queue
+            # depth as well was measured 25% SLOWER on a loaded 1-core
+            # host (every submit deferred -> flusher handoff per pump and
+            # batches that serialize against execution).
             bursting = now - self._last_submit < 0.0002 \
                 and config_mod.GlobalConfig.task_burst_defer
             self._last_submit = now
@@ -198,12 +205,56 @@ class _TaskSubmitter:
             threading.Thread(target=self._request_lease, daemon=True,
                              name="lease-req").start()
 
+    def _fast_acquire(self) -> Optional[dict]:
+        """Try the head's native lease pool (one binary frame served inside
+        the head's C loop — transport.cc FOP_LEASE_ACQ). None on miss or
+        ineligibility; the Python RPC path then arms the pool server-side
+        so the next request hits."""
+        if (self.pg is not None or self.runtime_env is not None
+                or not self.backend._head_fast
+                or not config_mod.GlobalConfig.fast_lease_client):
+            return None
+        from ray_tpu.runtime.protocol import _chaos_should_fail
+        if _chaos_should_fail("request_lease"):
+            return None  # chaos tests target the Python path; don't dodge it
+        from ray_tpu.runtime import protocol_native as _pn
+        if self._sig is None:
+            self._sig = wire.lease_sig(self.resources)
+        try:
+            status, blob = self.backend.head.call_fast(
+                _pn.FAST_LEASE_ACQ, key=_pn._U64.pack(self._sig),
+                timeout=5.0)
+        except Exception:  # noqa: BLE001 — any failure: use the RPC path
+            return None
+        if status != 1:
+            return None
+        import pickle
+        try:
+            return pickle.loads(blob)
+        except Exception:  # noqa: BLE001
+            return None
+
     def _request_lease(self) -> None:
         try:
             while not self.backend._closed:
                 with self.lock:
                     if not self.pending:
                         return
+                grant = self._fast_acquire()
+                if grant is not None:
+                    lease = _Lease(grant["lease_id"], grant["worker_addr"],
+                                   grant["worker_id"],
+                                   node_addr=grant.get("node_addr", ""),
+                                   fast_key=grant.get("fast_key"))
+                    if self.backend.is_dead_addr(lease.worker_addr):
+                        # pooled corpse: release via the PYTHON path so the
+                        # head invalidates it instead of re-pooling
+                        self._release_to_cluster(lease, fast_ok=False)
+                        time.sleep(0.1)
+                        continue
+                    with self.lock:
+                        self.leases[lease.lease_id] = lease
+                    break
                 with self.lock:
                     n_pending = len(self.pending)
                 payload = {"resources": self.resources,
@@ -283,8 +334,15 @@ class _TaskSubmitter:
             t.attempts += 1
         state = _BatchState(lease, tasks)
         client = self.backend.peers.get(lease.worker_addr)
-        client.call_batch_cb("push_task", [t.payload for t in tasks],
-                             lambda i, v, e: self._on_reply(state, i, v, e))
+        cb = lambda i, v, e: self._on_reply(state, i, v, e)  # noqa: E731
+        if len(tasks) > 1 and config_mod.GlobalConfig.task_combined_push:
+            # combined fast path: one frame + one pickle each way for the
+            # whole batch (worker half: worker_main.handle_push_task_batch)
+            client.call_combined_cb(
+                "push_task_batch", [t.payload for t in tasks], cb)
+        else:
+            client.call_batch_cb("push_task",
+                                 [t.payload for t in tasks], cb)
 
     def _on_reply(self, state: _BatchState, i: int, value,
                   exc: Optional[BaseException]) -> None:
@@ -368,10 +426,16 @@ class _TaskSubmitter:
         except RpcError:
             return None
 
-    def _release_to_cluster(self, lease: _Lease, timeout: float = 5.0) -> None:
+    def _release_to_cluster(self, lease: _Lease, timeout: float = 5.0,
+                            fast_ok: bool = True) -> None:
         """Release via the head; if the head forgot the lease (it restarted
         and leases are process state), return the worker straight to its
         node daemon so the pool slot isn't leaked.
+
+        fast_ok: a healthy-worker release of a native-pool grant goes back
+        as one fast frame (the head's C loop re-pools it instantly, zero
+        Python). Corpse releases pass fast_ok=False so the head's Python
+        invalidates the grant instead of re-pooling a dead worker.
 
         The fallback fires ONLY on an explicit "unknown lease" reply. A
         transport failure is ambiguous — the head may have completed the
@@ -379,6 +443,18 @@ class _TaskSubmitter:
         to someone else, and a late direct return would hand one worker to
         two leases. Leaking a slot on an unreachable head is the safe side.
         """
+        if fast_ok and lease.fast_key is not None \
+                and self.backend._head_fast \
+                and config_mod.GlobalConfig.fast_lease_client:
+            from ray_tpu.runtime import protocol_native as _pn
+            try:
+                status, _ = self.backend.head.call_fast(
+                    _pn.FAST_LEASE_REL, key=_pn._U64.pack(lease.fast_key),
+                    timeout=timeout)
+                if status == 1:
+                    return
+            except Exception:  # noqa: BLE001 — fall through to the RPC
+                pass
         try:
             known = bool(self.backend.head.call(
                 "release_lease", {"lease_id": lease.lease_id},
@@ -410,7 +486,9 @@ class _TaskSubmitter:
         with self.lock:
             self.leases.pop(lease.lease_id, None)
         self.backend.peers.invalidate(lease.worker_addr)
-        self._release_to_cluster(lease)
+        # corpse path: never fast-release (the head must invalidate the
+        # grant, not hand the dead worker to the next acquirer)
+        self._release_to_cluster(lease, fast_ok=False)
 
     def reap_idle(self, linger_s: float) -> None:
         now = time.monotonic()
@@ -570,10 +648,18 @@ class _ActorSubmitter:
                         client = self.backend.peers.get(addr)
                         # one frame for the whole run of queued calls; the
                         # actor executes them in seq order either way
-                        client.call_batch_cb(
-                            "push_task", [t.payload for t in tasks],
-                            lambda i, v, e, ts=tasks:
-                                self._on_reply(ts[i], v, e))
+                        if len(tasks) > 1 and \
+                                config_mod.GlobalConfig.task_combined_push:
+                            client.call_combined_cb(
+                                "push_task_batch",
+                                [t.payload for t in tasks],
+                                lambda i, v, e, ts=tasks:
+                                    self._on_reply(ts[i], v, e))
+                        else:
+                            client.call_batch_cb(
+                                "push_task", [t.payload for t in tasks],
+                                lambda i, v, e, ts=tasks:
+                                    self._on_reply(ts[i], v, e))
                     except BaseException as e:  # noqa: BLE001
                         # Synchronous submit failure (stale address etc):
                         # popped tasks must NOT vanish — requeue in order
@@ -873,6 +959,10 @@ class ClusterBackend:
             except RpcError:
                 pass
         return bool(self.head.call("kv_del", {"key": key}, timeout=5.0))
+
+    def kv_keys(self, prefix: str = "") -> list:
+        keys = self.head.call_retrying("kv_keys", {"prefix": prefix})
+        return list(keys or [])
 
     #: how long a dead address stays blacklisted — a fresh worker at the
     #: same host gets a new port, so false positives only cost one

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core import config as config_mod
 from ray_tpu.core._native import (POLICY_HYBRID, POLICY_NODE_AFFINITY,
                                   POLICY_SPREAD, ClusterState)
+from ray_tpu.runtime import wire
 from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
 
 # actor states (reference: gcs.proto ActorTableData.ActorState)
@@ -231,11 +232,13 @@ class _ActorEntry:
 
 class _LeaseEntry:
     __slots__ = ("lease_id", "node_id", "worker_id", "worker_addr",
-                 "resources", "created", "peer", "pg_id", "bundle_index")
+                 "resources", "created", "peer", "pg_id", "bundle_index",
+                 "fast_key")
 
     def __init__(self, lease_id: str, node_id: str, worker_id: bytes,
                  worker_addr: str, resources: Dict[str, float], peer,
-                 pg_id: Optional[bytes] = None, bundle_index: int = -1):
+                 pg_id: Optional[bytes] = None, bundle_index: int = -1,
+                 fast_key: Optional[int] = None):
         self.lease_id = lease_id
         self.node_id = node_id
         self.worker_id = worker_id
@@ -245,6 +248,9 @@ class _LeaseEntry:
         self.peer = peer  # requesting connection; leases die with it
         self.pg_id = pg_id
         self.bundle_index = bundle_index
+        # set for grants living in the native lease pool (transport.cc
+        # FastLease): peer is None there — the C loop tracks the holder
+        self.fast_key = fast_key
 
 
 class Head:
@@ -361,6 +367,25 @@ class Head:
         # connection drops (reference: raylet returns leased workers when
         # the owner dies — lease lifetime is bound to the owner)
         self.server.on_disconnect = self._on_client_disconnect
+        # Native lease pool (verdict: "serve lease grant/release as native
+        # fast frames with Python keeping only placement policy"): Python
+        # pre-stocks ready grants per resource-shape sig; FOP_LEASE_ACQ/REL
+        # are then served inside the C loop. Python keeps placement
+        # (stocking), reclamation, and drain policy.
+        self._fast_lease_on = (
+            config_mod.GlobalConfig.fast_lease_pool_target > 0
+            and self._kv.native  # fast frames route into the C loop
+            and hasattr(self.server, "lease_stock")
+            and hasattr(self.server, "on_disconnect_conn"))
+        self._restock_wants: Dict[int, dict] = {}  # sig -> resources/want
+        self._stocked_sigs: set = set()  # every sig EVER stocked (drain set)
+        self._restock_kick = threading.Event()
+        self._fast_hits_seen = 0
+        self._fast_idle_since = time.monotonic()
+        if self._fast_lease_on:
+            self.server.on_disconnect_conn = self._on_conn_fastlease_reclaim
+            threading.Thread(target=self._restock_loop, daemon=True,
+                             name="head-fastlease").start()
         self.address = self.server.address
         if self._persist_path:
             self._persist_thread = threading.Thread(
@@ -679,16 +704,27 @@ class Head:
     def _schedule_and_acquire(self, resources: Dict[str, float],
                               policy: str = "hybrid",
                               affinity_node: str = "",
-                              soft: bool = False) -> Optional[str]:
-        with self._lock:
-            node_id = self.cluster.schedule(
-                resources, _POLICY_BY_NAME.get(policy, POLICY_HYBRID),
-                affinity_node=affinity_node, soft=soft)
-            if node_id is None:
+                              soft: bool = False,
+                              _drain_on_busy: bool = True) -> Optional[str]:
+        for attempt in (0, 1):
+            with self._lock:
+                node_id = self.cluster.schedule(
+                    resources, _POLICY_BY_NAME.get(policy, POLICY_HYBRID),
+                    affinity_node=affinity_node, soft=soft)
+                if node_id is not None:
+                    if not self.cluster.acquire(node_id, resources):
+                        node_id = None
+                if node_id is not None:
+                    return node_id
+            # busy: pooled fast-lease grants may be holding the capacity —
+            # drain them (opportunistic pool, never allowed to starve real
+            # demand past one round-trip) and retry once
+            if attempt == 0 and _drain_on_busy and self._fast_lease_on:
+                if self._drain_all_pools() == 0:
+                    return None
+            else:
                 return None
-            if not self.cluster.acquire(node_id, resources):
-                return None
-            return node_id
+        return None
 
     def _release(self, node_id: str, resources: Dict[str, float]) -> None:
         with self._lock:
@@ -709,6 +745,48 @@ class Head:
         """
         resources = p["resources"]
         pg_id = p.get("pg_id")
+        if self._fastlease_eligible(p, pg_id):
+            # Arm the native pool for this shape: the NEXT acquire for it
+            # is served inside the C loop (this one proceeds via Python).
+            # Depth is DEMAND-BOUNDED by the submitter's pending hint: an
+            # isolated task (pending=1) stocks nothing, a burst stocks up
+            # to the target — unconditional deep stocking caused a
+            # worker-spawn storm that starved small hosts.
+            want = min(config_mod.GlobalConfig.fast_lease_pool_target,
+                       max(0, int(p.get("pending", 1)) - 1))
+            sig = wire.lease_sig(resources)
+            if want > 0:
+                with self._lock:
+                    cur = self._restock_wants.get(sig)
+                    self._restock_wants[sig] = {
+                        "resources": dict(resources),
+                        "want": max(want, cur["want"] if cur else 0)}
+                self._restock_kick.set()
+            # Pool-first: a Python-path request for a pooled shape serves
+            # straight from the pool. Without this, concurrent requester
+            # threads race their own pool — the Python path sees the
+            # capacity as busy, drain-on-busy rips grants out from under
+            # sibling fast acquires, and restock churns (measured 28%
+            # single-client regression).
+            item = self.server.lease_unstock(sig)
+            if item is not None:
+                _lkey, blob = item
+                try:
+                    g = pickle.loads(blob)
+                except Exception:  # noqa: BLE001
+                    g = None
+                if g is not None:
+                    with self._lock:
+                        e = self._leases.get(g["lease_id"])
+                        if e is not None:
+                            # now an ordinary Python lease: bound to this
+                            # peer for disconnect reclaim, out of the
+                            # C-side tables
+                            e.peer = ctx.peer if ctx is not None else None
+                            e.fast_key = None
+                    return {k: g[k] for k in
+                            ("lease_id", "node_id", "worker_id",
+                             "worker_addr", "node_addr", "shm_name")}
         if pg_id is not None:
             return self._pg_lease(p, pg_id, ctx)
         node_id = self._schedule_and_acquire(
@@ -853,6 +931,11 @@ class Head:
             lease = self._leases.pop(p["lease_id"], None)
         if lease is None:
             return False
+        if lease.fast_key is not None and self._fast_lease_on:
+            # a Python-path release of a pooled/held fast grant (corpse
+            # detected by the client, head restart fallback): make sure the
+            # C loop can't re-grant it
+            self.server.lease_invalidate(lease.fast_key)
         if lease.pg_id is not None:
             self._bundle_return(lease.pg_id, lease.bundle_index,
                                 lease.resources)
@@ -866,6 +949,123 @@ class Head:
             except RpcError:
                 pass
         return True
+
+    # ------------------------------------------------- native lease pool
+
+    def _fastlease_eligible(self, p, pg_id) -> bool:
+        return (self._fast_lease_on and pg_id is None
+                and not p.get("runtime_env") and not p.get("affinity_node")
+                and p.get("policy", "hybrid") == "hybrid"
+                and not p.get("soft"))
+
+    def _restock_loop(self) -> None:
+        """Placement policy half of the native lease pool: keep each hot
+        shape's pool stocked to target depth so FOP_LEASE_ACQ hits in C.
+        Stocking is strictly opportunistic — any request that finds the
+        cluster busy drains every pool first (_drain_all_pools), so pooled
+        grants can only ever cost one retry round-trip of latency."""
+        while not self._stopped.is_set():
+            self._restock_kick.wait(timeout=1.0)
+            self._restock_kick.clear()
+            with self._lock:
+                wants = dict(self._restock_wants)
+            for sig, entry in wants.items():
+                while (not self._stopped.is_set()
+                       and self.server.lease_depth(sig) < entry["want"]):
+                    with self._lock:
+                        # a drain may have disarmed this sig since the
+                        # snapshot — stocking past it would orphan grants
+                        if sig not in self._restock_wants:
+                            break
+                    if not self._stock_one(sig, entry["resources"]):
+                        break
+
+    def _stock_one(self, sig: int, resources: Dict[str, float]) -> bool:
+        node_id = self._schedule_and_acquire(resources, _drain_on_busy=False)
+        if node_id is None:
+            return False
+        with self._lock:
+            node = self._nodes.get(node_id)
+        if node is None:
+            self._release(node_id, resources)
+            return False
+        try:
+            grant = self._node_clients.get(node.address).call(
+                "lease_worker", {"resources": resources,
+                                 "runtime_env": None})
+        except RpcError:
+            self._release(node_id, resources)
+            self._mark_node_dead(node_id, "lease rpc failed (pool stock)")
+            return False
+        except Exception:  # noqa: BLE001
+            self._release(node_id, resources)
+            return False
+        if not isinstance(grant, dict) or "worker_id" not in grant:
+            self._release(node_id, resources)
+            return False
+        with self._lock:
+            self._lease_counter += 1
+            n = self._lease_counter
+            lease_id = f"l{self.incarnation}.{n}"
+            self._leases[lease_id] = _LeaseEntry(
+                lease_id, node_id, grant["worker_id"], grant["worker_addr"],
+                dict(resources), None, fast_key=n)
+        blob = pickle.dumps({
+            "lease_id": lease_id, "node_id": node_id,
+            "worker_id": grant["worker_id"],
+            "worker_addr": grant["worker_addr"],
+            "node_addr": node.address, "shm_name": node.shm_name,
+            "fast_key": n}, protocol=5)
+        if not self.server.lease_stock(sig, n, blob):
+            self._h_release_lease({"lease_id": lease_id}, None)
+            return False
+        with self._lock:
+            self._stocked_sigs.add(sig)
+        return True
+
+    def _drain_all_pools(self) -> int:
+        """Return every POOLED (un-held) fast grant to the cluster and stop
+        restocking until fresh eligible demand re-arms it."""
+        with self._lock:
+            # drain every sig that EVER stocked, not just currently-armed
+            # ones: a restock racing a previous drain can deposit grants
+            # after the wants were cleared, and wants-only draining would
+            # orphan them (they held a node's capacity forever)
+            sigs = set(self._restock_wants) | set(self._stocked_sigs)
+            self._restock_wants.clear()  # re-armed by fresh eligible demand
+        n = 0
+        for sig in sigs:
+            while True:
+                item = self.server.lease_unstock(sig)
+                if item is None:
+                    break
+                _lkey, blob = item
+                try:
+                    g = pickle.loads(blob)
+                except Exception:  # noqa: BLE001
+                    continue
+                self._h_release_lease({"lease_id": g["lease_id"]}, None)
+                n += 1
+        return n
+
+    def _on_conn_fastlease_reclaim(self, conn_id: int, peer) -> None:
+        """A connection died holding native-granted leases: release them
+        (role of the peer-based reclaim in _on_client_disconnect, driven by
+        the C-side holder table instead of Python lease entries)."""
+        items = self.server.lease_reclaim_conn(conn_id)
+        if not items:
+            return
+
+        def _reclaim():
+            for _lkey, _sig, blob in items:
+                try:
+                    g = pickle.loads(blob)
+                except Exception:  # noqa: BLE001
+                    continue
+                self._h_release_lease({"lease_id": g["lease_id"]}, None)
+
+        threading.Thread(target=_reclaim, daemon=True,
+                         name="fastlease-reclaim").start()
 
     # ----------------------------------------------------------------- actors
 
@@ -1165,6 +1365,19 @@ class Head:
                               if e.node_id == node_id and
                               e.state in (ALIVE, PENDING, RESTARTING)]
         self._node_clients.invalidate(node.address)
+        if self._fast_lease_on:
+            # fast grants on the dead node are garbage: pull them out of
+            # the C pool/held tables so they can't be (re-)granted. The
+            # node's resource accounting is already gone (remove_node), so
+            # just drop the entries.
+            with self._lock:
+                dead_fast = [l for l in self._leases.values()
+                             if l.node_id == node_id
+                             and l.fast_key is not None]
+            for l in dead_fast:
+                self.server.lease_invalidate(l.fast_key)
+                with self._lock:
+                    self._leases.pop(l.lease_id, None)
         self.pubsub.publish("cluster_events", {
             "event": "node_dead", "node_id": node_id, "reason": reason,
             "ts": time.time()})
@@ -1192,6 +1405,19 @@ class Head:
             # periodic retry of pending placement groups: resources freed
             # by finished leases/actors may now fit a queued reservation
             self._try_schedule_pgs()
+            # idle decay of the native lease pool: no acquires for a full
+            # drain window -> hand the pooled capacity back
+            if self._fast_lease_on:
+                stats = self.server.lease_stats()
+                if stats is not None:
+                    if stats["hits"] != self._fast_hits_seen:
+                        self._fast_hits_seen = stats["hits"]
+                        self._fast_idle_since = time.monotonic()
+                    elif (stats["pooled"] > 0
+                          and time.monotonic()
+                          - getattr(self, "_fast_idle_since", 0.0)
+                          > config_mod.GlobalConfig.fast_lease_idle_drain_s):
+                        self._drain_all_pools()
 
     # ------------------------------------------------------- placement groups
 
@@ -1212,17 +1438,27 @@ class Head:
         """Attempt atomic reservation of every pending PG (called on create
         and periodically from the health loop so freed resources are
         picked up)."""
-        with self._lock:
-            for pg in self._pgs.values():
-                if pg["state"] != "PENDING":
-                    continue
-                nodes = self.cluster.schedule_bundles(pg["bundles"],
-                                                      pg["strategy"])
-                if nodes is not None:
-                    pg["nodes"] = nodes
-                    pg["state"] = "CREATED"
-                    self._persist_dirty = True
-                    self._persist_kick.set()
+        for attempt in (0, 1):
+            pending = False
+            with self._lock:
+                for pg in self._pgs.values():
+                    if pg["state"] != "PENDING":
+                        continue
+                    nodes = self.cluster.schedule_bundles(pg["bundles"],
+                                                          pg["strategy"])
+                    if nodes is not None:
+                        pg["nodes"] = nodes
+                        pg["state"] = "CREATED"
+                        self._persist_dirty = True
+                        self._persist_kick.set()
+                    else:
+                        pending = True
+            # a reservation that can't fit may be blocked by pooled
+            # fast-lease grants: drain them and retry once (the pool is
+            # opportunistic — real demand always wins)
+            if not (attempt == 0 and pending and self._fast_lease_on
+                    and self._drain_all_pools() > 0):
+                return
 
     def _h_remove_pg(self, p, ctx):
         with self._lock:
@@ -1264,10 +1500,25 @@ class Head:
                     total[k] = total.get(k, 0.0) + v
             return total
 
+    def _pooled_fast_keys(self) -> set:
+        """fast_keys of grants sitting UN-HELD in the C pool — their
+        resources are reclaimable in one drain, so capacity reports treat
+        them as free (without this, pooled grants masked freed capacity
+        from the elastic-train grow monitor and the autoscaler)."""
+        if not self._fast_lease_on:
+            return set()
+        try:
+            return set(self.server.lease_pooled_keys())
+        except Exception:  # noqa: BLE001
+            return set()
+
     def _h_available_resources(self, p, ctx):
         total = self._h_cluster_resources(p, ctx)
+        pooled = self._pooled_fast_keys()
         with self._lock:
             for lease in self._leases.values():
+                if lease.fast_key is not None and lease.fast_key in pooled:
+                    continue  # grantable pool cache counts as available
                 for k, v in lease.resources.items():
                     total[k] = total.get(k, 0.0) - v
             for e in self._actors.values():
@@ -1323,6 +1574,7 @@ class Head:
         """Demand + per-node busyness for the autoscaler reconciler
         (reference: gcs_autoscaler_state_manager.h cluster state reply)."""
         horizon = time.time() - p.get("demand_window_s", 10.0)
+        pooled = self._pooled_fast_keys()
         with self._lock:
             for k in [k for k, d in self._demand.items()
                       if d["ts"] < horizon]:
@@ -1342,6 +1594,8 @@ class Head:
                     demand.extend(dict(b) for b in pg["bundles"])
             busy_nodes = set()
             for lease in self._leases.values():
+                if lease.fast_key is not None and lease.fast_key in pooled:
+                    continue  # pooled cache must not block idle drain
                 busy_nodes.add(lease.node_id)
             for e in self._actors.values():
                 if e.state in (ALIVE, PENDING, RESTARTING) and e.node_id:
@@ -1382,6 +1636,8 @@ class Head:
                             "reason": e.reason}
                            for aid, e in self._actors.items()],
                 "leases": len(self._leases),
+                "fast_lease": (self.server.lease_stats()
+                               if self._fast_lease_on else None),
                 "placement_groups": [
                     {"pg_id": pid.hex(), "strategy": pg["strategy"],
                      "nodes": pg["nodes"], "name": pg["name"]}

@@ -49,12 +49,13 @@ def _proc_dead(proc) -> bool:
 
 class _WorkerEntry:
     __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id",
-                 "chips", "env_key", "idle_since")
+                 "chips", "env_key", "idle_since", "cgroup_leaf")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
                  env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
+        self.cgroup_leaf: Optional[str] = None
         self.address: Optional[str] = None
         self.ready = threading.Event()
         self.state = "starting"  # starting | idle | leased | actor | dead
@@ -141,6 +142,14 @@ class NodeDaemon:
         # see only a dropped connection and need the real cause
         self._fates: "collections.OrderedDict[str, str]" = \
             collections.OrderedDict()
+        # cgroup-v2 worker isolation (best-effort; no-op without a
+        # writable unified hierarchy — see runtime/cgroup.py)
+        self.cgroups = None
+        if cfg.worker_cgroup:
+            from ray_tpu.runtime.cgroup import CgroupManager
+            self.cgroups = CgroupManager(session, root=cfg.cgroup_root)
+            if not self.cgroups.enabled:
+                self.cgroups = None
         if cfg.memory_monitor_refresh_ms > 0:
             # memory monitor + OOM worker killing (reference:
             # common/memory_monitor.h:52 polling + retriable-FIFO victim
@@ -267,6 +276,13 @@ class NodeDaemon:
                worker_id.hex(), config_mod.GlobalConfig.to_json()]
         proc = subprocess.Popen(cmd, env=env, cwd=cwd)
         entry = _WorkerEntry(worker_id, proc, env_key=env_key)
+        if self.cgroups is not None:
+            # post-fork attach (reference: cgroup_setup.h AddProcessToCgroup)
+            entry.cgroup_leaf = self.cgroups.create_worker_group(
+                WorkerID(worker_id).hex(),
+                memory_bytes=config_mod.GlobalConfig
+                .worker_memory_limit_bytes)
+            self.cgroups.attach(entry.cgroup_leaf, proc.pid)
         entry.chips = chips
         with self._lock:
             self._workers[worker_id] = entry
@@ -289,6 +305,13 @@ class NodeDaemon:
             if self.chips is not None:
                 self.chips.release(entry.worker_id)
         entry.ready.set()
+        if self.cgroups is not None:
+            # kernel-enforced OOM (memory.max breach) leaves no trace in
+            # our RSS poller — memory.events is the authoritative record
+            ev = self.cgroups.memory_events(entry.cgroup_leaf)
+            if ev.get("oom_kill", 0) > 0:
+                self._record_fate(entry.worker_id, "oom")
+            self.cgroups.remove_worker_group(entry.cgroup_leaf)
         if self._stopped.is_set() or prev_state == "stopping":
             return
         with self._lock:
@@ -777,6 +800,8 @@ class NodeDaemon:
                 w.proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
                 w.proc.kill()
+        if self.cgroups is not None:
+            self.cgroups.shutdown()
         try:
             self._clients.get(self.head_addr).call(
                 "unregister_node", {"node_id": self.node_id}, timeout=2.0)

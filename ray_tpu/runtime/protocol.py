@@ -434,6 +434,48 @@ class RpcClient:
                     e if isinstance(e, RpcError) else RpcError(repr(e)))
         return fut
 
+    def call_combined_cb(self, method: str, payloads: list,
+                         callback) -> None:
+        """One request frame carrying N sub-payloads; the peer replies once
+        with a list of N (value, error) pairs fanned out to
+        callback(i, value, error). Same contract as the native transport's
+        call_combined_cb."""
+        n = len(payloads)
+
+        def fanout(value, error):
+            if error is None and (not isinstance(value, list)
+                                  or len(value) != n):
+                error = RpcError(
+                    f"malformed combined reply for {method}: "
+                    f"expected list of {n}, got {type(value).__name__}")
+            if error is not None:
+                for i in range(n):
+                    callback(i, None, error)
+                return
+            for i, (v, e) in enumerate(value):
+                callback(i, v, e)
+
+        cfg = config_mod.GlobalConfig
+        if cfg.testing_rpc_delay_ms:
+            time.sleep(cfg.testing_rpc_delay_ms / 1000.0)
+        with self._id_lock:
+            self._next_id += 1
+            req_id = self._next_id
+        with self._pending_lock:
+            self._pending[req_id] = fanout
+        try:
+            if _chaos.should_fail(method):
+                raise ChaosInjectedError(f"chaos: {method}")
+            sock = self._connect()
+            data = pickle.dumps((method, payloads), protocol=5)
+            _send_frame(sock, req_id, data, self._wlock)
+        except BaseException as e:  # noqa: BLE001
+            with self._pending_lock:
+                entry = self._pending.pop(req_id, None)
+            if entry is not None:
+                fanout(None,
+                       e if isinstance(e, RpcError) else RpcError(repr(e)))
+
     def call_batch_cb(self, method: str, payloads: list,
                       callback) -> list:
         """Send many requests of one method in a single frame.

@@ -38,8 +38,10 @@ _POLL_BATCH = 512
 
 # fast-path ops (mirror transport.cc FastOp)
 FAST_PUT, FAST_GET, FAST_DEL, FAST_PING = 1, 2, 3, 4
+FAST_LEASE_ACQ, FAST_LEASE_REL = 5, 6
 _FAST_REQ = struct.Struct("<BBIQ")  # op, flags, klen, vlen
 _FAST_REP = struct.Struct("<BQ")    # status, vlen
+_U64 = struct.Struct("<Q")
 
 
 class _RtEvent(ctypes.Structure):
@@ -93,6 +95,33 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_fastpath_keys.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastlease_stock.restype = ctypes.c_int
+    lib.rt_fastlease_stock.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64]
+    lib.rt_fastlease_unstock.restype = ctypes.c_int
+    lib.rt_fastlease_unstock.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastlease_invalidate.restype = ctypes.c_int
+    lib.rt_fastlease_invalidate.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.rt_fastlease_reclaim_conn.restype = ctypes.c_int64
+    lib.rt_fastlease_reclaim_conn.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastlease_pooled.restype = ctypes.c_int64
+    lib.rt_fastlease_pooled.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastlease_stats.restype = ctypes.c_int
+    lib.rt_fastlease_stats.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_fastlease_depth.restype = ctypes.c_int64
+    lib.rt_fastlease_depth.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.rt_buf_free.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -309,6 +338,9 @@ class RpcServer:
         self._conns: Dict[int, _ServerConn] = {}
         self._stopped = False
         self.on_disconnect: Optional[Callable[[Any], None]] = None
+        # (conn_id, peer) variant — the head uses conn_id to reclaim
+        # native-fastpath lease grants held by the dropped connection
+        self.on_disconnect_conn: Optional[Callable[[int, Any], None]] = None
         self._listener = self._transport.listen(self, host, port)
         if not self._listener:
             raise OSError(f"cannot listen on {host}:{port}")
@@ -451,9 +483,107 @@ class RpcServer:
             off += vlen
         return items
 
+    # -- native lease pool (host-side policy access; served peer-side by
+    # FOP_LEASE_ACQ/REL inside the C loop — see transport.cc FastLease) --
+
+    def lease_stock(self, sig: int, lease_key: int, grant: bytes) -> bool:
+        t = self._transport
+        return t.fastlib.rt_fastlease_stock(
+            t.loop, self._listener, sig, lease_key, grant, len(grant)) == 0
+
+    def lease_unstock(self, sig: int) -> Optional[tuple]:
+        """Pop one pooled grant: (lease_key, grant_bytes) or None."""
+        t = self._transport
+        out_key = ctypes.c_uint64()
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        rc = t.fastlib.rt_fastlease_unstock(
+            t.loop, self._listener, sig, ctypes.byref(out_key),
+            ctypes.byref(out), ctypes.byref(out_len))
+        if rc != 1:
+            return None
+        try:
+            return out_key.value, ctypes.string_at(out.value, out_len.value)
+        finally:
+            t.fastlib.rt_buf_free(out)
+
+    def lease_invalidate(self, lease_key: int) -> int:
+        """2 = was held, 1 = was pooled, 0 = unknown, -1 = no fastpath."""
+        t = self._transport
+        return t.fastlib.rt_fastlease_invalidate(t.loop, self._listener,
+                                                 lease_key)
+
+    def lease_reclaim_conn(self, conn_id: int) -> list:
+        """All grants held by a disconnected conn: [(lease_key, sig,
+        grant_bytes)], removed from the C-side table."""
+        t = self._transport
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        n = t.fastlib.rt_fastlease_reclaim_conn(
+            t.loop, self._listener, conn_id, ctypes.byref(out),
+            ctypes.byref(out_len))
+        if n <= 0:
+            if n > -1 and out.value:
+                t.fastlib.rt_buf_free(out)
+            return []
+        try:
+            buf = ctypes.string_at(out.value, out_len.value)
+        finally:
+            t.fastlib.rt_buf_free(out)
+        items = []
+        off = 0
+        for _ in range(n):
+            lkey, sig, blen = struct.unpack_from("<QQQ", buf, off)
+            off += 24
+            items.append((lkey, sig, buf[off:off + blen]))
+            off += blen
+        return items
+
+    def lease_pooled_keys(self) -> list:
+        """Lease keys currently POOLED (grantable, un-held) — their
+        resources are reclaimable in one drain and therefore reported as
+        available by the head."""
+        t = self._transport
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        n = t.fastlib.rt_fastlease_pooled(
+            t.loop, self._listener, ctypes.byref(out),
+            ctypes.byref(out_len))
+        if n <= 0:
+            if n > -1 and out.value:
+                t.fastlib.rt_buf_free(out)
+            return []
+        try:
+            buf = ctypes.string_at(out.value, out_len.value)
+        finally:
+            t.fastlib.rt_buf_free(out)
+        keys = []
+        for off in range(0, len(buf), 16):
+            _sig, lkey = struct.unpack_from("<QQ", buf, off)
+            keys.append(lkey)
+        return keys
+
+    def lease_stats(self) -> Optional[dict]:
+        t = self._transport
+        out = (ctypes.c_uint64 * 4)()
+        if t.fastlib.rt_fastlease_stats(t.loop, self._listener, out) != 0:
+            return None
+        return {"hits": out[0], "misses": out[1], "pooled": out[2],
+                "held": out[3]}
+
+    def lease_depth(self, sig: int) -> int:
+        t = self._transport
+        return max(0, t.fastlib.rt_fastlease_depth(t.loop, self._listener,
+                                                   sig))
+
     def _on_conn_closed(self, conn: _ServerConn) -> None:
         conn.alive = False
         self._conns.pop(conn.conn_id, None)
+        if not self._stopped and self.on_disconnect_conn is not None:
+            try:
+                self.on_disconnect_conn(conn.conn_id, conn.peer)
+            except Exception:  # noqa: BLE001
+                pass
         if self.on_disconnect is not None and not self._stopped:
             try:
                 self.on_disconnect(conn.peer)
@@ -618,6 +748,55 @@ class RpcClient:
                 fut.set_exception(
                     e if isinstance(e, RpcError) else RpcError(repr(e)))
         return fut
+
+    def call_combined_cb(self, method: str, payloads: list,
+                         callback: Callable[
+                             [int, Any, Optional[BaseException]], None]
+                         ) -> None:
+        """Send N sub-payloads as ONE request frame; the peer replies ONCE
+        with a list of N (value, error) pairs which fan out to
+        callback(i, value, error) on the dispatcher thread.
+
+        One pending entry, one pickle each way — the cheap half of the
+        combined-batch fast path (worker half: worker_main
+        _BatchReplyCollector). On transport failure every callback fires
+        with the error, same contract as call_batch_cb."""
+        from ray_tpu.runtime.protocol import (ChaosInjectedError, RpcError,
+                                              _chaos_should_fail)
+        cfg = config_mod.GlobalConfig
+        if cfg.testing_rpc_delay_ms:
+            time.sleep(cfg.testing_rpc_delay_ms / 1000.0)
+        n = len(payloads)
+
+        def fanout(value, error):
+            if error is None and (not isinstance(value, list)
+                                  or len(value) != n):
+                error = RpcError(
+                    f"malformed combined reply for {method}: "
+                    f"expected list of {n}, got {type(value).__name__}")
+            if error is not None:
+                for i in range(n):
+                    callback(i, None, error)
+                return
+            for i, (v, e) in enumerate(value):
+                callback(i, v, e)
+
+        req_id = self._alloc_id()
+        with self._pending_lock:
+            self._pending[req_id] = fanout
+        try:
+            if _chaos_should_fail(method):
+                raise ChaosInjectedError(f"chaos: {method}")
+            conn = self._connect()
+            data = pickle.dumps((method, payloads), protocol=5)
+            if not self._send(conn, req_id, data):
+                raise RpcError(f"connection to {self.address} lost")
+        except BaseException as e:  # noqa: BLE001
+            with self._pending_lock:
+                entry = self._pending.pop(req_id, None)
+            if entry is not None:
+                fanout(None,
+                       e if isinstance(e, RpcError) else RpcError(repr(e)))
 
     def call_batch_cb(self, method: str, payloads: list,
                       callback: Callable[[int, Any, Optional[BaseException]],

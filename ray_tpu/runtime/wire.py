@@ -99,6 +99,18 @@ def task_from_wire(p: dict) -> TaskSpec:
     )
 
 
+def lease_sig(resources) -> int:
+    """Stable u64 signature of a plain resource shape — the key of the
+    head's native lease pool (transport.cc FastLease). Head and clients
+    must compute it identically; only pg-less, default-policy,
+    default-runtime-env shapes are pooled."""
+    import hashlib
+    items = ",".join(f"{k}={float(resources[k]):.6f}"
+                     for k in sorted(resources))
+    return int.from_bytes(
+        hashlib.blake2b(items.encode(), digest_size=8).digest(), "little")
+
+
 def actor_to_wire(spec: ActorCreationSpec) -> Tuple[dict, list]:
     args, contained = _args_to_wire(spec.args)
     kw = serialization.serialize(spec.kwargs)

@@ -1,0 +1,72 @@
+"""cgroup-v2 worker isolation (SURVEY §2.1 cgroup row; reference:
+src/ray/common/cgroup/cgroup_setup.h). The manager is exercised against a
+fake unified hierarchy in a tmpdir — real kernels need delegation we can't
+assume in CI — plus a no-op-degradation check against a non-cgroup dir."""
+
+import os
+
+from ray_tpu.runtime.cgroup import CgroupManager
+
+
+def make_fake_root(tmp_path):
+    root = tmp_path / "cg"
+    root.mkdir()
+    (root / "cgroup.controllers").write_text("cpuset cpu io memory pids\n")
+    return str(root)
+
+
+def test_slice_and_worker_leaf_lifecycle(tmp_path):
+    root = make_fake_root(tmp_path)
+    mgr = CgroupManager("sess1", root=root)
+    assert mgr.enabled
+    assert os.path.isdir(os.path.join(root, "rtpu-sess1"))
+    # controllers requested for children
+    sub = os.path.join(root, "rtpu-sess1", "cgroup.subtree_control")
+    assert "+memory" in open(sub).read()
+
+    leaf = mgr.create_worker_group("abcd" * 8,
+                                   memory_bytes=256 * 1024 * 1024,
+                                   num_cpus=2.0)
+    assert leaf is not None and os.path.isdir(leaf)
+    assert open(os.path.join(leaf, "memory.max")).read() == \
+        str(256 * 1024 * 1024)
+    assert open(os.path.join(leaf, "memory.oom.group")).read() == "1"
+    assert open(os.path.join(leaf, "cpu.weight")).read() == "200"
+
+    assert mgr.attach(leaf, 12345)
+    assert open(os.path.join(leaf, "cgroup.procs")).read() == "12345"
+
+    # kernel OOM-kill accounting parses
+    with open(os.path.join(leaf, "memory.events"), "w") as f:
+        f.write("low 0\nhigh 3\nmax 7\noom 1\noom_kill 1\n")
+    ev = mgr.memory_events(leaf)
+    assert ev["oom_kill"] == 1 and ev["max"] == 7
+
+    # real cgroupfs rmdir succeeds while control files exist; the tmpfs
+    # fake needs them cleared first to model that semantic
+    for f in os.listdir(leaf):
+        os.unlink(os.path.join(leaf, f))
+    mgr.remove_worker_group(leaf)
+    assert not os.path.isdir(leaf)
+    os.unlink(sub)
+    mgr.shutdown()
+    assert not os.path.isdir(os.path.join(root, "rtpu-sess1"))
+
+
+def test_degrades_to_noop_without_v2_root(tmp_path):
+    mgr = CgroupManager("sess2", root=str(tmp_path / "not-cgroup"))
+    assert not mgr.enabled
+    assert mgr.create_worker_group("ffff" * 8, memory_bytes=1) is None
+    assert not mgr.attach(None, 1)
+    assert mgr.memory_events(None) == {}
+    mgr.shutdown()  # no-op, no raise
+
+
+def test_cpu_weight_bounds(tmp_path):
+    root = make_fake_root(tmp_path)
+    mgr = CgroupManager("sess3", root=root)
+    tiny = mgr.create_worker_group("aa" * 16, num_cpus=0.001)
+    assert open(os.path.join(tiny, "cpu.weight")).read() == "1"
+    huge = mgr.create_worker_group("bb" * 16, num_cpus=500.0)
+    assert open(os.path.join(huge, "cpu.weight")).read() == "10000"
+    mgr.shutdown()

@@ -1,0 +1,101 @@
+"""Combined batch frames: one request frame carrying N task payloads, one
+reply frame carrying N (value, error) pairs (cluster_backend._push_batch →
+worker_main.handle_push_task_batch → _BatchReplyCollector).
+
+Protocol-level coverage on both transports plus end-to-end semantics the
+suite's throughput tests don't pin down: per-task error isolation inside a
+batch, ordering, and the malformed-reply guard."""
+
+import threading
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.runtime.protocol import DEFERRED, RpcClient, RpcServer
+
+
+@pytest.fixture
+def echo_server():
+    # a combined-method handler receives the WHOLE payload list and must
+    # reply with one (value, error) pair per item — the worker's
+    # handle_push_task_batch contract
+    def handle_echo(payloads, ctx):
+        out = []
+        for p in payloads:
+            if p == "boom":
+                out.append((None, ValueError("boom payload")))
+            else:
+                out.append((("echo", p), None))
+        return out
+
+    def handle_bad_combined(payload, ctx):
+        return "not-a-list"  # malformed combined reply
+
+    srv = RpcServer({"echo": handle_echo,
+                     "bad": handle_bad_combined}, name="combined-test")
+    yield srv
+    srv.stop()
+
+
+def test_call_combined_cb_fans_out(echo_server):
+    client = RpcClient(echo_server.address)
+    got = {}
+    done = threading.Event()
+
+    def cb(i, v, e):
+        got[i] = (v, e)
+        if len(got) == 4:
+            done.set()
+
+    client.call_combined_cb("echo", ["a", "b", "boom", "c"], cb)
+    assert done.wait(10), f"only {len(got)} callbacks fired"
+    assert got[0] == (("echo", "a"), None)
+    assert got[3] == (("echo", "c"), None)
+    # per-item error isolation: item 2 failed, neighbours unaffected
+    assert got[2][0] is None and isinstance(got[2][1], ValueError)
+    client.close()
+
+
+def test_combined_malformed_reply_surfaces_error(echo_server):
+    client = RpcClient(echo_server.address)
+    got = {}
+    done = threading.Event()
+
+    def cb(i, v, e):
+        got[i] = (v, e)
+        if len(got) == 2:
+            done.set()
+
+    client.call_combined_cb("bad", ["x", "y"], cb)
+    assert done.wait(10)
+    for i in (0, 1):
+        v, e = got[i]
+        assert v is None and e is not None, \
+            f"malformed combined reply not surfaced: {got[i]}"
+    client.close()
+
+
+def test_batch_error_isolation_end_to_end():
+    """One failing task inside a burst must not poison its batchmates."""
+    rt.init(num_cpus=2, _system_config={
+        "object_store_memory_bytes": 64 * 1024 * 1024})
+    try:
+        @rt.remote
+        def maybe_fail(i):
+            if i == 7:
+                raise RuntimeError(f"task {i} fails")
+            return i * 2
+
+        refs = [maybe_fail.remote(i) for i in range(20)]
+        ok, bad = 0, 0
+        for i, r in enumerate(refs):
+            try:
+                v = rt.get(r, timeout=60)
+                assert v == i * 2
+                ok += 1
+            except Exception:
+                assert i == 7
+                bad += 1
+        assert ok == 19 and bad == 1
+    finally:
+        rt.shutdown()

@@ -1,0 +1,114 @@
+"""Mixtral MoE model family on the virtual 8-device CPU mesh.
+
+Coverage mirrors test_models.py's llama suite: single-device shape/finite +
+training sanity, spec alignment, and expert-parallel (ep) forward parity
+against the dense routing reference (SURVEY §2.6 EP row exercised through
+a FULL model, not just the layer)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import mixtral
+
+
+def make_inputs(cfg, B=2, L=16, seed=0):
+    return jax.random.randint(jax.random.PRNGKey(seed), (B, L), 0,
+                              cfg.vocab_size)
+
+
+class TestMixtralSingleDevice:
+    def test_forward_shape_and_finite(self):
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = make_inputs(cfg)
+        logits = jax.jit(functools.partial(mixtral.forward, cfg=cfg))(
+            params, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_loss_decreases_with_sgd(self):
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = make_inputs(cfg, B=4, L=16)
+        loss_grad = jax.jit(jax.value_and_grad(
+            functools.partial(mixtral.loss_fn, cfg=cfg)))
+        l0, g = loss_grad(params, tokens)
+        assert np.isfinite(float(l0))
+        params2 = jax.tree.map(lambda p, gi: p - 0.3 * gi, params, g)
+        l1, _ = loss_grad(params2, tokens)
+        assert float(l1) < float(l0)
+
+    def test_param_specs_align(self):
+        cfg = mixtral.MixtralConfig.tiny()
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+        specs = mixtral.param_specs(cfg)
+        jax.tree.map(lambda p, s: None, params, specs)  # same structure
+        flat_p = jax.tree.leaves(params)
+        flat_s = jax.tree.leaves(specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        for p, s in zip(flat_p, flat_s):
+            assert len(s) <= p.ndim
+
+    def test_active_vs_total_params(self):
+        cfg = mixtral.MixtralConfig.tiny()
+        assert mixtral.active_params(cfg) < mixtral.num_params(cfg)
+        # 8x7B headline sanity: ~13B active of ~47B total
+        big = mixtral.MixtralConfig.mixtral_8x7b()
+        total = mixtral.num_params(big)
+        active = mixtral.active_params(big)
+        assert 40e9 < total < 55e9
+        assert 10e9 < active < 16e9
+
+
+class TestMixtralExpertParallel:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        devices = np.array(jax.devices()[:8]).reshape(2, 4)
+        return Mesh(devices, ("dp", "ep"))
+
+    def test_ep_forward_matches_dense(self, mesh):
+        """ep=4 all_to_all dispatch == dense per-expert loop (large
+        capacity factor so no tokens drop)."""
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32, remat=False,
+                                         capacity_factor=8.0)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(1))
+        tokens = make_inputs(cfg, B=4, L=16, seed=3)
+
+        dense = jax.jit(functools.partial(mixtral.forward, cfg=cfg))(
+            params, tokens)
+
+        specs = mixtral.param_specs(cfg)
+
+        def drop_non_mesh_axes(s):
+            return P(*[ax if ax in ("dp", "ep") else None for ax in s])
+
+        sharded_specs = jax.tree.map(drop_non_mesh_axes, specs,
+                                     is_leaf=lambda x: isinstance(x, P))
+        sp = jax.device_put(params, jax.tree.map(
+            lambda s: NamedSharding(mesh, s), sharded_specs,
+            is_leaf=lambda x: isinstance(x, P)))
+        st = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+        with mesh:
+            ep_out = jax.jit(functools.partial(
+                mixtral.forward, cfg=cfg, mesh=mesh))(sp, st)
+        np.testing.assert_allclose(np.asarray(ep_out), np.asarray(dense),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_ep_train_step_decreases_loss(self, mesh):
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32, remat=False)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = make_inputs(cfg, B=4, L=16)
+        with mesh:
+            loss_grad = jax.jit(jax.value_and_grad(functools.partial(
+                mixtral.loss_fn, cfg=cfg, mesh=mesh)))
+            l0, g = loss_grad(params, tokens)
+            params2 = jax.tree.map(lambda p, gi: p - 0.3 * gi, params, g)
+            l1, _ = loss_grad(params2, tokens)
+        assert np.isfinite(float(l0))
+        assert float(l1) < float(l0)
