@@ -54,8 +54,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
             return {"address": "existing"}
         raise RuntimeError("ray_tpu.init() called twice "
                            "(pass ignore_reinit_error=True to tolerate)")
+    # fresh table per session: a previous init()'s _system_config in this
+    # process must not leak into this cluster (observed: one test module's
+    # worker_pool_max capping the next module's pool → lease starvation)
+    from ray_tpu.core.config import GlobalConfig, reset_to_defaults
+    reset_to_defaults()
     if _system_config:
-        from ray_tpu.core.config import GlobalConfig
         GlobalConfig.apply(_system_config)
     if local_mode:
         merged = dict(resources or {})

@@ -131,6 +131,17 @@ def _parse(typ: type, raw: Any) -> Any:
 GlobalConfig = _Config()
 
 
+def reset_to_defaults() -> None:
+    """Restore the table to defaults + env overrides, IN PLACE so every
+    `from ... import GlobalConfig` alias sees it. init() calls this
+    before applying a session's _system_config: without it, overrides
+    from a previous init() in the same process (e.g. an earlier test's
+    worker_pool_max) silently leak into the next session's cluster."""
+    fresh = _Config()
+    GlobalConfig._values.clear()
+    GlobalConfig._values.update(fresh._values)
+
+
 def reload_from_env() -> None:
     """Re-read env overrides (used by spawned workers after env setup)."""
     global GlobalConfig

@@ -173,6 +173,13 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs, axis_names=None):
     axis_names: optional set of mesh axes to treat as MANUAL; the rest stay
     auto (GSPMD keeps sharding them) — used to run the pipeline/ring loops
     manually while fsdp/tp remain compiler-managed.
+
+    Only jax>=0.8's native axis_names= form is used for partial-manual.
+    The old experimental `auto=` spelling miscompiles on jax 0.4.x GSPMD
+    (manual-subgroup CHECK aborts in the SPMD partitioner, PartitionId
+    UNIMPLEMENTED for axis_index) so we degrade to FULL manual instead:
+    axes the specs don't mention become replicated rather than
+    compiler-sharded — same results, redundant compute on those axes.
     """
     try:
         from jax import shard_map as _sm
@@ -180,12 +187,7 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs, axis_names=None):
         from jax.experimental.shard_map import shard_map as _sm
     partial_variants = [{}]
     if axis_names is not None:
-        # jax>=0.8 spells partial-manual as axis_names={manual}; older
-        # jax.experimental.shard_map spells it auto={the rest}.
-        partial_variants = [
-            {"axis_names": set(axis_names)},
-            {"auto": frozenset(mesh.axis_names) - set(axis_names)},
-        ]
+        partial_variants = [{"axis_names": set(axis_names)}, {}]
     for extra in partial_variants:
         for kw in ({"check_vma": False}, {"check_rep": False}, {}):
             try:

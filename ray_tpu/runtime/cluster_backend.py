@@ -330,8 +330,12 @@ class _TaskSubmitter:
             self.backend._store_task_error(t.spec, exc, t.pins)
 
     def _push_batch(self, lease: _Lease, tasks: list) -> None:
+        now = time.time()
         for t in tasks:
             t.attempts += 1
+            # scheduler-phase marker: lease assignment time, carried on the
+            # wire so the worker's sched:: span can split queue vs transport
+            t.payload["lease_ts"] = now
         state = _BatchState(lease, tasks)
         client = self.backend.peers.get(lease.worker_addr)
         cb = lambda i, v, e: self._on_reply(state, i, v, e)  # noqa: E731
@@ -899,6 +903,11 @@ class ClusterBackend:
     def _flush_telemetry(self) -> None:
         from ray_tpu.util import metrics as metrics_mod
         try:
+            # scheduler-backlog gauge: tasks enqueued but not yet pushed to
+            # a leased worker (len() is atomic; no submitter locks needed)
+            depth = sum(len(s.pending)
+                        for s in list(self._submitters.values()))
+            metrics_mod.queue_depth_gauge().set(depth)
             snap = metrics_mod.snapshot()
             events = self.event_buffer.drain()
             # bounded object-table summary for `list objects` (reference:

@@ -24,14 +24,21 @@ class TaskEventBuffer:
         self._dropped = 0
 
     def record(self, *, name: str, task_id: str, kind: str,
-               start: float, end: float, ok: bool) -> None:
+               start: float, end: float, ok: bool, **extra: Any) -> None:
+        """Record one span. ``extra`` carries optional fields — notably
+        the trace context trio (trace_id/span_id/parent_span_id) the OTLP
+        exporter links spans by; falsy values are dropped so old-format
+        events keep their exact seed shape."""
         with self._lock:
             if len(self._events) >= self.MAX_BUFFER:
                 self._dropped += 1
                 return
-            self._events.append({
-                "name": name, "task_id": task_id, "kind": kind,
-                "start": start, "end": end, "ok": ok})
+            e = {"name": name, "task_id": task_id, "kind": kind,
+                 "start": start, "end": end, "ok": ok}
+            for k, v in extra.items():
+                if v:
+                    e[k] = v
+            self._events.append(e)
 
     def drain(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -382,6 +382,11 @@ class Head:
         self._restock_kick = threading.Event()
         self._fast_hits_seen = 0
         self._fast_idle_since = time.monotonic()
+        # Python-path pool consumption (the pool-first branch in
+        # _h_request_lease) — folded into the idle-drain activity check:
+        # C-loop `hits` alone misses a steady Python-path consumer and
+        # drains a pool that is actually hot.
+        self._py_unstocks = 0
         if self._fast_lease_on:
             self.server.on_disconnect_conn = self._on_conn_fastlease_reclaim
             threading.Thread(target=self._restock_loop, daemon=True,
@@ -731,6 +736,16 @@ class Head:
             if node_id in self._nodes and self._nodes[node_id].alive:
                 self.cluster.release(node_id, resources)
 
+    def _record_sched_event(self, name: str, start: float) -> None:
+        """Head-side scheduler-phase span, appended straight to the
+        timeline deque (the head process has no telemetry flush loop —
+        it IS the collector). Lets `python -m ray_tpu trace` / timeline
+        consumers see where lease grants came from and what they cost."""
+        self._task_events.append({
+            "name": name, "task_id": "", "kind": "sched",
+            "start": start, "end": time.time(), "ok": True,
+            "worker": "head", "node": "head"})
+
     def _h_request_lease(self, p, ctx):
         """Grant (node, worker) for a resource shape; None if infeasible now.
 
@@ -743,6 +758,7 @@ class Head:
         (reference: PlacementGroupSchedulingStrategy +
         placement_group_resource_manager.h bundle accounting).
         """
+        t_req = time.time()
         resources = p["resources"]
         pg_id = p.get("pg_id")
         if self._fastlease_eligible(p, pg_id):
@@ -775,6 +791,7 @@ class Head:
                     g = pickle.loads(blob)
                 except Exception:  # noqa: BLE001
                     g = None
+                live = False
                 if g is not None:
                     with self._lock:
                         e = self._leases.get(g["lease_id"])
@@ -784,9 +801,19 @@ class Head:
                             # C-side tables
                             e.peer = ctx.peer if ctx is not None else None
                             e.fast_key = None
+                            live = True
+                if live:
+                    self._py_unstocks += 1
+                    self._record_sched_event("lease::pool", t_req)
                     return {k: g[k] for k in
                             ("lease_id", "node_id", "worker_id",
                              "worker_addr", "node_addr", "shm_name")}
+                # Stale pooled grant: its _LeaseEntry was already released
+                # (resources returned by _h_release_lease) — handing it
+                # out would point the client at a worker the node may
+                # have reclaimed, and release of the reissued lease_id
+                # would be a no-op double-spend. Discard and fall through
+                # to ordinary scheduling.
         if pg_id is not None:
             return self._pg_lease(p, pg_id, ctx)
         node_id = self._schedule_and_acquire(
@@ -834,6 +861,7 @@ class Head:
             self._leases[lease_id] = _LeaseEntry(
                 lease_id, node_id, grant["worker_id"], grant["worker_addr"],
                 resources, ctx.peer if ctx is not None else None)
+        self._record_sched_event("lease::grant", t_req)
         return {"lease_id": lease_id, "node_id": node_id,
                 "worker_id": grant["worker_id"],
                 "worker_addr": grant["worker_addr"],
@@ -1410,8 +1438,14 @@ class Head:
             if self._fast_lease_on:
                 stats = self.server.lease_stats()
                 if stats is not None:
-                    if stats["hits"] != self._fast_hits_seen:
-                        self._fast_hits_seen = stats["hits"]
+                    # activity = C-loop pool hits PLUS Python-path pool
+                    # consumption (pool-first in _h_request_lease): either
+                    # one proves the pool is earning its keep. Counting
+                    # only `hits` drained pools under pure Python-path
+                    # load — a false idle.
+                    activity = stats["hits"] + self._py_unstocks
+                    if activity != self._fast_hits_seen:
+                        self._fast_hits_seen = activity
                         self._fast_idle_since = time.monotonic()
                     elif (stats["pooled"] > 0
                           and time.monotonic()

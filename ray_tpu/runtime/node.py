@@ -264,7 +264,8 @@ class NodeDaemon:
     def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
                       chips: Optional[list] = None,
                       env_key: str = "",
-                      cwd: Optional[str] = None) -> _WorkerEntry:
+                      cwd: Optional[str] = None,
+                      num_cpus: float = 0.0) -> _WorkerEntry:
         worker_id = WorkerID.from_random().binary()
         from ray_tpu.runtime.spawn import child_env
         extra = {"RTPU_SESSION": self.session}
@@ -278,10 +279,14 @@ class NodeDaemon:
         entry = _WorkerEntry(worker_id, proc, env_key=env_key)
         if self.cgroups is not None:
             # post-fork attach (reference: cgroup_setup.h AddProcessToCgroup)
+            # num_cpus is the lease's CPU request: it becomes the leaf's
+            # cpu.weight, so a 2-CPU task outweighs a 0.5-CPU task under
+            # contention (proportional, not a hard cap)
             entry.cgroup_leaf = self.cgroups.create_worker_group(
                 WorkerID(worker_id).hex(),
                 memory_bytes=config_mod.GlobalConfig
-                .worker_memory_limit_bytes)
+                .worker_memory_limit_bytes,
+                num_cpus=num_cpus)
             self.cgroups.attach(entry.cgroup_leaf, proc.pid)
         entry.chips = chips
         with self._lock:
@@ -474,9 +479,10 @@ class NodeDaemon:
             # acquired for this lease (same contract as invalid TPU shapes)
             return {"invalid": f"runtime_env setup failed: {e}"}
         n_tpu = int(p.get("resources", {}).get("TPU", 0) or 0)
+        n_cpu = float(p.get("resources", {}).get("CPU", 0) or 0.0)
         if n_tpu > 0 and self.chips is not None:
             return self._lease_tpu_worker(n_tpu, cfg, env_extra=env_extra,
-                                          cwd=cwd)
+                                          cwd=cwd, num_cpus=n_cpu)
         with self._lock:
             pool = self._idle.setdefault(env_key, [])
             while pool:
@@ -510,7 +516,7 @@ class NodeDaemon:
                 pass
         try:
             entry = self._spawn_worker(env_extra=env_extra, env_key=env_key,
-                                       cwd=cwd)
+                                       cwd=cwd, num_cpus=n_cpu)
         finally:
             with self._lock:
                 self._spawn_reserved -= 1
@@ -547,7 +553,8 @@ class NodeDaemon:
                     "kv_get", {"key": k}))
         return env_key, rtenv.worker_env(renv, wd_path), wd_path
 
-    def _lease_tpu_worker(self, n_tpu: int, cfg, env_extra=None, cwd=None):
+    def _lease_tpu_worker(self, n_tpu: int, cfg, env_extra=None, cwd=None,
+                          num_cpus: float = 0.0):
         from ray_tpu.accelerators.tpu import TPUAcceleratorManager
         try:
             TPUAcceleratorManager.validate_chip_request(n_tpu)
@@ -568,7 +575,8 @@ class NodeDaemon:
             env = TPUAcceleratorManager.visibility_env(chips)
             if env_extra:
                 env = {**env_extra, **env}
-            entry = self._spawn_worker(env_extra=env, chips=chips, cwd=cwd)
+            entry = self._spawn_worker(env_extra=env, chips=chips, cwd=cwd,
+                                       num_cpus=num_cpus)
         finally:
             with self._lock:
                 self._spawn_reserved -= 1

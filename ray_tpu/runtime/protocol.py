@@ -135,13 +135,16 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
 class HandlerContext:
     """Passed to every handler; allows deferred replies and peer identity."""
 
-    __slots__ = ("_conn", "_req_id", "peer", "replied")
+    __slots__ = ("_conn", "_req_id", "peer", "replied", "slot_ids")
 
     def __init__(self, conn: "_ServerConn", req_id: int):
         self._conn = conn
         self._req_id = req_id
         self.peer = conn.peer
         self.replied = False
+        # combined frames with pre-allocated per-slot reply ids (eager
+        # per-task replies — see call_combined_cb); None on plain requests
+        self.slot_ids = None
 
     def reply(self, value: Any = None, error: Optional[BaseException] = None) -> None:
         if self.replied:
@@ -149,8 +152,19 @@ class HandlerContext:
         self.replied = True
         self._conn.send_reply(self._req_id, value, error)
 
+    def reply_to(self, req_id: int, value: Any = None,
+                 error: Optional[BaseException] = None) -> None:
+        """Reply to one pre-allocated slot id of a combined frame (the
+        caller registered a pending entry per slot). Unlike reply(),
+        callable many times — once per distinct slot."""
+        self._conn.send_reply(req_id, value, error)
+
 
 DEFERRED = object()  # handler sentinel: "I'll call ctx.reply() later"
+
+#: final main-request reply of an eagerly-flushed combined call: every
+#: slot already got its own reply frame; this closes the exchange
+_COMBINED_DONE = "__combined_done__"
 
 
 class _ServerConn:
@@ -293,7 +307,12 @@ class RpcServer:
         if ctx is None:
             ctx = HandlerContext(conn, req_id)
         try:
-            method, body = msg
+            # frames are (method, body) or (method, body, slot_ids) — the
+            # 3rd element carries pre-allocated per-slot reply ids of an
+            # eager combined call; old 2-tuple frames stay accepted
+            method, body = msg[0], msg[1]
+            if len(msg) > 2 and msg[2]:
+                ctx.slot_ids = list(msg[2])
             handler = self.handlers.get(method)
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
@@ -436,45 +455,83 @@ class RpcClient:
 
     def call_combined_cb(self, method: str, payloads: list,
                          callback) -> None:
-        """One request frame carrying N sub-payloads; the peer replies once
-        with a list of N (value, error) pairs fanned out to
-        callback(i, value, error). Same contract as the native transport's
+        """One request frame carrying N sub-payloads, with a pre-allocated
+        reply id per slot shipped alongside (3rd frame element). An eager
+        peer replies per slot the moment that slot finishes — so a slot
+        whose result a batchmate depends on is never withheld behind
+        unfinished batchmates — then closes with _COMBINED_DONE on the
+        main id. A peer that instead replies once with a list of N
+        (value, error) pairs (old single-reply servers, plain handlers)
+        is equally accepted. Either way callback(i, value, error) fires
+        exactly once per slot. Same contract as the native transport's
         call_combined_cb."""
         n = len(payloads)
+        lock = threading.Lock()
+        done = [False] * n
 
-        def fanout(value, error):
-            if error is None and (not isinstance(value, list)
-                                  or len(value) != n):
-                error = RpcError(
-                    f"malformed combined reply for {method}: "
-                    f"expected list of {n}, got {type(value).__name__}")
-            if error is not None:
-                for i in range(n):
-                    callback(i, None, error)
-                return
-            for i, (v, e) in enumerate(value):
-                callback(i, v, e)
+        def fire(i, value, error):
+            with lock:
+                if done[i]:
+                    return
+                done[i] = True
+            callback(i, value, error)
 
         cfg = config_mod.GlobalConfig
         if cfg.testing_rpc_delay_ms:
             time.sleep(cfg.testing_rpc_delay_ms / 1000.0)
         with self._id_lock:
+            slot_ids = []
+            for _ in range(n):
+                self._next_id += 1
+                slot_ids.append(self._next_id)
             self._next_id += 1
             req_id = self._next_id
+
+        def fanout(value, error):
+            # main-request reply: drop the slot entries first so a peer
+            # that answered with one combined list (or an error) doesn't
+            # leak N pending entries
+            with self._pending_lock:
+                for rid in slot_ids:
+                    self._pending.pop(rid, None)
+            if error is None:
+                if isinstance(value, list) and len(value) == n:
+                    for i, (v, e) in enumerate(value):
+                        fire(i, v, e)
+                    return
+                if value == _COMBINED_DONE:
+                    # all slots should have their own replies by now (the
+                    # marker is sent last on the same ordered connection);
+                    # any still-unfired slot means the peer lost one
+                    error = RpcError(
+                        f"combined call {method}: peer finished without "
+                        f"replying to every slot")
+                else:
+                    error = RpcError(
+                        f"malformed combined reply for {method}: "
+                        f"expected list of {n}, got {type(value).__name__}")
+            for i in range(n):
+                fire(i, None, error)
+
         with self._pending_lock:
+            for i, rid in enumerate(slot_ids):
+                self._pending[rid] = (lambda v, e, i=i: fire(i, v, e))
             self._pending[req_id] = fanout
         try:
             if _chaos.should_fail(method):
                 raise ChaosInjectedError(f"chaos: {method}")
             sock = self._connect()
-            data = pickle.dumps((method, payloads), protocol=5)
+            data = pickle.dumps((method, payloads, slot_ids), protocol=5)
             _send_frame(sock, req_id, data, self._wlock)
         except BaseException as e:  # noqa: BLE001
             with self._pending_lock:
                 entry = self._pending.pop(req_id, None)
+                for rid in slot_ids:
+                    self._pending.pop(rid, None)
             if entry is not None:
-                fanout(None,
-                       e if isinstance(e, RpcError) else RpcError(repr(e)))
+                err = e if isinstance(e, RpcError) else RpcError(repr(e))
+                for i in range(n):
+                    fire(i, None, err)
 
     def call_batch_cb(self, method: str, payloads: list,
                       callback) -> list:

@@ -272,13 +272,16 @@ class _Transport:
 class HandlerContext:
     """Passed to every handler; allows deferred replies and peer identity."""
 
-    __slots__ = ("_conn", "_req_id", "peer", "replied")
+    __slots__ = ("_conn", "_req_id", "peer", "replied", "slot_ids")
 
     def __init__(self, conn: "_ServerConn", req_id: int):
         self._conn = conn
         self._req_id = req_id
         self.peer = conn.peer
         self.replied = False
+        # combined frames with pre-allocated per-slot reply ids (eager
+        # per-task replies — see call_combined_cb); None on plain requests
+        self.slot_ids = None
 
     def reply(self, value: Any = None,
               error: Optional[BaseException] = None) -> None:
@@ -286,6 +289,13 @@ class HandlerContext:
             return
         self.replied = True
         self._conn.send_reply(self._req_id, value, error)
+
+    def reply_to(self, req_id: int, value: Any = None,
+                 error: Optional[BaseException] = None) -> None:
+        """Reply to one pre-allocated slot id of a combined frame (the
+        caller registered a pending entry per slot). Unlike reply(),
+        callable many times — once per distinct slot."""
+        self._conn.send_reply(req_id, value, error)
 
 
 class _ServerConn:
@@ -368,19 +378,25 @@ class RpcServer:
             for rid, m, body in msg[1]:
                 self._dispatch_one(conn, rid, m, body)
             return
-        self._dispatch_one(conn, req_id, method, msg[1])
+        # (method, body) or (method, body, slot_ids) — the 3rd element
+        # carries pre-allocated per-slot reply ids of an eager combined
+        # call; old 2-tuple frames stay accepted
+        slot_ids = list(msg[2]) if len(msg) > 2 and msg[2] else None
+        self._dispatch_one(conn, req_id, method, msg[1], slot_ids)
 
     def _dispatch_one(self, conn: _ServerConn, req_id: int, method: str,
-                      body: Any) -> None:
+                      body: Any, slot_ids=None) -> None:
         if method in self.inline_methods:
-            self._run_handler(conn, req_id, method, body)
+            self._run_handler(conn, req_id, method, body, slot_ids)
         else:
-            self._pool.submit(self._run_handler, conn, req_id, method, body)
+            self._pool.submit(self._run_handler, conn, req_id, method, body,
+                              slot_ids)
 
     def _run_handler(self, conn: _ServerConn, req_id: int, method: str,
-                     body: Any) -> None:
+                     body: Any, slot_ids=None) -> None:
         from ray_tpu.runtime.protocol import DEFERRED, RpcError
         ctx = HandlerContext(conn, req_id)
+        ctx.slot_ids = slot_ids
         try:
             handler = self.handlers.get(method)
             if handler is None:
@@ -753,50 +769,83 @@ class RpcClient:
                          callback: Callable[
                              [int, Any, Optional[BaseException]], None]
                          ) -> None:
-        """Send N sub-payloads as ONE request frame; the peer replies ONCE
-        with a list of N (value, error) pairs which fan out to
-        callback(i, value, error) on the dispatcher thread.
-
-        One pending entry, one pickle each way — the cheap half of the
-        combined-batch fast path (worker half: worker_main
-        _BatchReplyCollector). On transport failure every callback fires
-        with the error, same contract as call_batch_cb."""
-        from ray_tpu.runtime.protocol import (ChaosInjectedError, RpcError,
+        """Send N sub-payloads as ONE request frame, with a pre-allocated
+        reply id per slot shipped alongside (3rd frame element). An eager
+        peer replies per slot the moment that slot finishes — so a slot
+        whose result a batchmate depends on is never withheld behind
+        unfinished batchmates — then closes with _COMBINED_DONE on the
+        main id. A peer that instead replies once with a list of N
+        (value, error) pairs (old single-reply servers, plain handlers)
+        is equally accepted. Either way callback(i, value, error) fires
+        exactly once per slot, on the dispatcher thread (must not block).
+        On transport failure every not-yet-fired callback fires with the
+        error, same contract as call_batch_cb."""
+        from ray_tpu.runtime.protocol import (ChaosInjectedError,
+                                              RpcError, _COMBINED_DONE,
                                               _chaos_should_fail)
         cfg = config_mod.GlobalConfig
         if cfg.testing_rpc_delay_ms:
             time.sleep(cfg.testing_rpc_delay_ms / 1000.0)
         n = len(payloads)
+        lock = threading.Lock()
+        done = [False] * n
+
+        def fire(i, value, error):
+            with lock:
+                if done[i]:
+                    return
+                done[i] = True
+            callback(i, value, error)
+
+        slot_ids = [self._alloc_id() for _ in range(n)]
+        req_id = self._alloc_id()
 
         def fanout(value, error):
-            if error is None and (not isinstance(value, list)
-                                  or len(value) != n):
-                error = RpcError(
-                    f"malformed combined reply for {method}: "
-                    f"expected list of {n}, got {type(value).__name__}")
-            if error is not None:
-                for i in range(n):
-                    callback(i, None, error)
-                return
-            for i, (v, e) in enumerate(value):
-                callback(i, v, e)
+            # main-request reply: drop the slot entries first so a peer
+            # that answered with one combined list (or an error) doesn't
+            # leak N pending entries
+            with self._pending_lock:
+                for rid in slot_ids:
+                    self._pending.pop(rid, None)
+            if error is None:
+                if isinstance(value, list) and len(value) == n:
+                    for i, (v, e) in enumerate(value):
+                        fire(i, v, e)
+                    return
+                if value == _COMBINED_DONE:
+                    # all slots should have their own replies by now (the
+                    # marker is sent last on the same ordered connection);
+                    # any still-unfired slot means the peer lost one
+                    error = RpcError(
+                        f"combined call {method}: peer finished without "
+                        f"replying to every slot")
+                else:
+                    error = RpcError(
+                        f"malformed combined reply for {method}: "
+                        f"expected list of {n}, got {type(value).__name__}")
+            for i in range(n):
+                fire(i, None, error)
 
-        req_id = self._alloc_id()
         with self._pending_lock:
+            for i, rid in enumerate(slot_ids):
+                self._pending[rid] = (lambda v, e, i=i: fire(i, v, e))
             self._pending[req_id] = fanout
         try:
             if _chaos_should_fail(method):
                 raise ChaosInjectedError(f"chaos: {method}")
             conn = self._connect()
-            data = pickle.dumps((method, payloads), protocol=5)
+            data = pickle.dumps((method, payloads, slot_ids), protocol=5)
             if not self._send(conn, req_id, data):
                 raise RpcError(f"connection to {self.address} lost")
         except BaseException as e:  # noqa: BLE001
             with self._pending_lock:
                 entry = self._pending.pop(req_id, None)
+                for rid in slot_ids:
+                    self._pending.pop(rid, None)
             if entry is not None:
-                fanout(None,
-                       e if isinstance(e, RpcError) else RpcError(repr(e)))
+                err = e if isinstance(e, RpcError) else RpcError(repr(e))
+                for i in range(n):
+                    fire(i, None, err)
 
     def call_batch_cb(self, method: str, payloads: list,
                       callback: Callable[[int, Any, Optional[BaseException]],

@@ -19,6 +19,7 @@ workers cache by key.
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Any, Dict, List, Tuple
 
 import cloudpickle
@@ -26,6 +27,7 @@ import cloudpickle
 from ray_tpu.core import serialization
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskArg, TaskSpec
+from ray_tpu.util import trace_context
 
 
 def export_function(fn: Any) -> Tuple[str, bytes]:
@@ -68,7 +70,14 @@ def task_to_wire(spec: TaskSpec, function_key: str = "") -> Tuple[dict, list]:
         "actor_id": spec.actor_id.binary() if spec.actor_id else None,
         "method_name": spec.method_name,
         "seq_no": spec.seq_no,
+        # scheduler-phase anchor: lets the worker separate queueing delay
+        # (submit → start) from execution in its recorded spans
+        "submit_ts": time.time(),
     }
+    # trace_id/parent_span_id/span_id: the child joins the submitter's
+    # ambient trace (util/trace_context). Receivers read these with
+    # .get(), so frames from a peer without them stay accepted.
+    trace_context.stamp(payload)
     return payload, contained
 
 

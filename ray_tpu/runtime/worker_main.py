@@ -33,7 +33,10 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import TaskCancelledError, TaskError
 from ray_tpu.runtime import wire
-from ray_tpu.runtime.protocol import DEFERRED, RpcClient, RpcError
+from ray_tpu.runtime.protocol import (_COMBINED_DONE, DEFERRED, RpcClient,
+                                      RpcError)
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import trace_context
 
 
 class _LogShipper:
@@ -177,6 +180,38 @@ class _BatchReplyCollector:
             self.ctx.reply(self.slots)
 
 
+class _EagerReplyCollector:
+    """Per-slot eager replies for a combined batch: each task's result is
+    flushed on its own pre-allocated req_id the moment it completes, then
+    a done marker closes the main req_id. Replaces the buffer-until-last
+    behaviour of _BatchReplyCollector when the client sent slot ids —
+    buffering deadlocked nested gets (task A in the batch blocked on a
+    ref produced by task B in the SAME batch: B's reply was withheld
+    until A finished, which never happened)."""
+
+    __slots__ = ("ctx", "slot_ids", "lock", "replied", "done")
+
+    def __init__(self, ctx, slot_ids):
+        self.ctx = ctx
+        self.slot_ids = slot_ids
+        self.lock = threading.Lock()
+        self.replied = [False] * len(slot_ids)
+        self.done = 0
+
+    def reply_at(self, i: int, value, error) -> None:
+        with self.lock:
+            if self.replied[i]:
+                return
+            self.replied[i] = True
+            self.done += 1
+            last = self.done == len(self.slot_ids)
+        self.ctx.reply_to(self.slot_ids[i], value, error)
+        if last:
+            # marker is sent AFTER every slot reply on the same ordered
+            # stream, so the client has fired all callbacks when it lands
+            self.ctx.reply(_COMBINED_DONE)
+
+
 class _SubCtx:
     """HandlerContext stand-in for one task inside a combined batch."""
 
@@ -252,8 +287,15 @@ class Executor:
         """N tasks in one frame, ONE combined reply frame (see
         _BatchReplyCollector). Tasks still route individually through
         their concurrency-group queues, so ordering semantics match the
-        per-task path exactly."""
-        coll = _BatchReplyCollector(ctx, len(payloads))
+        per-task path exactly. Clients that pre-allocated per-slot reply
+        ids (ctx.slot_ids) get each result flushed eagerly instead
+        (_EagerReplyCollector); old-format frames keep the single
+        combined reply."""
+        slot_ids = getattr(ctx, "slot_ids", None)
+        if slot_ids is not None and len(slot_ids) == len(payloads):
+            coll = _EagerReplyCollector(ctx, slot_ids)
+        else:
+            coll = _BatchReplyCollector(ctx, len(payloads))
         for i, p in enumerate(payloads):
             group = self._method_groups.get(p.get("method_name") or "")
             q = self._group_queues.get(group) if group else None
@@ -419,6 +461,13 @@ class Executor:
         self.worker.current_task_id = TaskID(task_id)
         if self.log_shipper is not None:
             self.log_shipper.set_owner(payload.get("owner") or None)
+        # restore the submitter's trace context as ambient for the task
+        # body: nested .remote() calls stamp THIS span as their parent,
+        # linking the cross-process chain into one trace. Contextvar, so
+        # async-actor dispatch carries it into the coroutine (the loop
+        # handoff snapshots this thread's context).
+        trace_tok = trace_context.activate(
+            payload.get("trace_id"), payload.get("span_id"))
         t_start = time.time()
         try:
             args, kwargs = self._resolve_args(payload["args"],
@@ -449,6 +498,7 @@ class Executor:
             return
         finally:
             self.worker.current_task_id = None
+            trace_context.deactivate(trace_tok)
         if payload.get("streaming"):
             self._stream_out(payload, ctx, result, t_start)
             return
@@ -460,13 +510,44 @@ class Executor:
         # task span -> event buffer (flushed by the telemetry thread;
         # reference: TaskEventBuffer state transitions)
         buf = getattr(self.backend, "event_buffer", None)
-        if buf is not None:
-            buf.record(
-                name=payload.get("name") or payload.get(
-                    "method_name") or "task",
-                task_id=TaskID(payload["task_id"]).hex()[:16],
-                kind="actor_task" if payload.get("actor_id") else "task",
-                start=t_start, end=time.time(), ok=ok)
+        if buf is None:
+            return
+        name = payload.get("name") or payload.get("method_name") or "task"
+        span_id = payload.get("span_id", "")
+        buf.record(
+            name=name,
+            task_id=TaskID(payload["task_id"]).hex()[:16],
+            kind="actor_task" if payload.get("actor_id") else "task",
+            start=t_start, end=time.time(), ok=ok,
+            trace_id=payload.get("trace_id", ""),
+            span_id=span_id,
+            parent_span_id=payload.get("parent_span_id", ""))
+        # scheduler-phase companion span: submit→start, a CHILD of the
+        # execution span so a trace view separates queueing delay from
+        # run time (reference: ray task-state timeline's
+        # PENDING_NODE_ASSIGNMENT..RUNNING segments)
+        submit_ts = payload.get("submit_ts")
+        if submit_ts is None:
+            return
+        try:
+            submit_ts = float(submit_ts)
+        except (TypeError, ValueError):
+            return
+        import hashlib
+        sched_sid = hashlib.sha256(
+            f"sched:{span_id or payload['task_id']!r}".encode()
+        ).hexdigest()[:16]
+        buf.record(
+            name=f"{name}::sched",
+            task_id=TaskID(payload["task_id"]).hex()[:16],
+            kind="sched",
+            start=submit_ts, end=t_start, ok=True,
+            trace_id=payload.get("trace_id", ""),
+            span_id=sched_sid,
+            parent_span_id=span_id,
+            lease_ts=payload.get("lease_ts"))
+        metrics_mod.submit_to_start_histogram().observe(
+            max(0.0, t_start - submit_ts))
 
     def _reply_error(self, payload: dict, ctx, exc: BaseException,
                      t_start: float) -> None:

@@ -180,6 +180,49 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Assemble one distributed trace from the head's timeline and print
+    it as an indented span tree (or JSON)."""
+    from ray_tpu.util.tracing import assemble_trace
+    address = load_address(args.address)
+    events = _client(address).call("timeline_dump")
+    roots = assemble_trace(events, trace_id=args.trace_id or "",
+                           task_id=args.task_id or "")
+    if not roots:
+        hint = args.trace_id or args.task_id or "<missing selector>"
+        print(f"no spans found for {hint} "
+              "(pass --trace-id or --task-id; spans appear after the "
+              "owners' next telemetry flush)", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(roots, indent=2, default=str))
+        return 0
+    print(f"trace {roots[0]['trace_id']}")
+
+    def show(span, depth):
+        dur_ms = max(0.0, span["end"] - span["start"]) * 1e3
+        mark = "" if span.get("ok", True) else "  [FAILED]"
+        where = span.get("worker", "")
+        where = f" @{where}" if where else ""
+        print(f"{'  ' * depth}- {span['name']}  {dur_ms:.2f}ms"
+              f"{where}{mark}  span={span['span_id']}")
+        for c in span["children"]:
+            show(c, depth + 1)
+
+    n = 0
+
+    def count(span):
+        nonlocal n
+        n += 1
+        for c in span["children"]:
+            count(c)
+    for r in roots:
+        show(r, 0)
+        count(r)
+    print(f"({n} spans)", file=sys.stderr)
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import Dashboard
     address = load_address(args.address)
@@ -242,6 +285,15 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("--out")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("trace", help="assemble one distributed trace "
+                                      "as a span tree")
+    sp.add_argument("--address")
+    sp.add_argument("--trace-id", default="")
+    sp.add_argument("--task-id", default="",
+                    help="resolve the trace via this task's exec span")
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     sp.add_argument("--address")

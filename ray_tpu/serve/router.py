@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve.multiplex import MUX_KWARG
+from ray_tpu.util import trace_context
 
 
 #: pubsub topic for routing-table pushes — controller publishes, routers
@@ -337,8 +338,11 @@ class Router:
             with self._lock:
                 self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
         try:
-            gen = replica.handle_request_streaming.options(
-                num_returns="streaming").remote(method_name, args, kwargs)
+            gen = self._traced_remote(
+                method_name,
+                lambda: replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        method_name, args, kwargs))
         except BaseException:
             done()
             raise
@@ -360,6 +364,43 @@ class Router:
             return self._submit(method_name, args, kwargs, model_id)
         return DeploymentResponse(ref, retry=retry)
 
+    def _traced_remote(self, method_name: str, submit):
+        """Run one replica submit under a router span: joins the caller's
+        ambient trace (or roots a fresh one for bare handle calls) and
+        installs the router span as ambient, so the actor-call submit
+        stamps it as parent — linking router→replica into one trace. The
+        span is recorded into this process's event buffer and rides the
+        normal telemetry flush to the head."""
+        amb = trace_context.current()
+        if amb is not None:
+            trace_id, parent = amb
+        else:
+            trace_id, parent = trace_context.new_trace_id(), ""
+        span_id = trace_context.new_span_id()
+        t0 = time.time()
+        tok = trace_context.activate(trace_id, span_id)
+        ok = True
+        try:
+            return submit()
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            trace_context.deactivate(tok)
+            try:
+                from ray_tpu.core.worker import global_worker
+                buf = getattr(getattr(global_worker, "backend", None),
+                              "event_buffer", None)
+                if buf is not None:
+                    buf.record(
+                        name=f"serve.router::{self._name}.{method_name}",
+                        task_id="", kind="serve_router",
+                        start=t0, end=time.time(), ok=ok,
+                        trace_id=trace_id, span_id=span_id,
+                        parent_span_id=parent)
+            except Exception:  # noqa: BLE001 — tracing is best-effort
+                pass
+
     def _submit(self, method_name: str, args: tuple, kwargs: dict,
                 model_id: str = ""):
         if model_id:
@@ -376,7 +417,10 @@ class Router:
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
         try:
-            ref = replica.handle_request.remote(method_name, args, kwargs)
+            ref = self._traced_remote(
+                method_name,
+                lambda: replica.handle_request.remote(
+                    method_name, args, kwargs))
         except BaseException:
             # undo the count on ANY submit failure (e.g. unpicklable args)
             # or the estimate would inflate forever and skew pow-2 choices

@@ -166,6 +166,27 @@ class Histogram(Metric):
                                for k, c in self._counts.items()}}
 
 
+# -- built-in runtime metrics (constructed on first use; the registry
+# shares state across repeat constructions, so call sites just call these)
+
+def submit_to_start_histogram() -> Histogram:
+    """Seconds from task submit (driver/worker stamped submit_ts) to
+    execution start at the worker — scheduler + queueing + transport,
+    observed worker-side (reference: ray scheduler placement-time
+    metrics). The companion scheduler-phase span carries the same value
+    per task; this is the aggregate view."""
+    return Histogram(
+        "submit_to_start",
+        description="seconds from task submit to worker execution start")
+
+
+def queue_depth_gauge() -> Gauge:
+    """Tasks waiting for a lease slot in this process's submitters
+    (driver-side view of scheduler backlog)."""
+    return Gauge("queue_depth",
+                 description="tasks pending without an assigned lease")
+
+
 def aggregate(per_worker: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:
     """Merge worker snapshots: counters/histograms sum, gauges last-write.
     (head-side; reference: metrics agent → Prometheus aggregation)."""

@@ -1,0 +1,72 @@
+"""Ambient W3C-style trace context for cross-process task causality.
+
+Role-equivalent to the reference's OpenTelemetry context propagation
+(reference: python/ray/util/tracing/tracing_helper.py — the serialized
+span context piggybacks on the task spec and is re-entered in the
+worker): a (trace_id, parent span_id) pair rides every submit frame
+(runtime/wire.py stamps it, runtime/worker_main.py restores it), so
+nested submits, actor calls and Serve router→replica hops emit spans
+linked into ONE trace instead of the seed's one-trace-per-task islands.
+
+The ambient slot is a contextvar, for the same reason the worker's log
+shipper uses one (worker_main._LogShipper): async-actor coroutines
+interleave on a single loop thread, and ``run_coroutine_threadsafe``
+snapshots the submitting thread's context, so each in-flight request
+keeps its own trace identity without any executor bookkeeping.
+
+Identifiers follow the W3C trace-context sizes: 32 hex chars for a
+trace id, 16 for a span id — exactly what the OTLP exporter
+(util/tracing.py) emits, so carried ids pass straight through.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import Optional, Tuple
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the active span, or None outside any."""
+    return _current.get()
+
+
+def activate(trace_id, span_id):
+    """Install a span as the ambient context; returns a token for
+    ``deactivate``. Missing/empty ids (old-format frames) install None,
+    so a mixed-version caller degrades to per-task traces, never an
+    error."""
+    if not trace_id or not span_id:
+        return _current.set(None)
+    return _current.set((str(trace_id), str(span_id)))
+
+
+def deactivate(token) -> None:
+    _current.reset(token)
+
+
+def stamp(payload: dict) -> dict:
+    """Stamp child trace-context fields onto an outgoing submit payload:
+    the child joins the ambient trace (or roots a fresh one) and gets its
+    own span id, which the executing worker records its span under and
+    re-activates as the ambient parent for further nesting."""
+    ctx = _current.get()
+    if ctx is None:
+        payload["trace_id"] = new_trace_id()
+        payload["parent_span_id"] = ""
+    else:
+        payload["trace_id"] = ctx[0]
+        payload["parent_span_id"] = ctx[1]
+    payload["span_id"] = new_span_id()
+    return payload

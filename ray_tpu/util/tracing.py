@@ -30,15 +30,46 @@ SERVICE_NAME = "ray_tpu"
 
 
 def _span_ids(e: Dict[str, Any]) -> tuple:
-    """(trace_id_hex32, span_id_hex16): trace groups by task lineage —
-    the task id IS the natural trace key; span id folds in the start
-    time so retries of one task become distinct spans on one trace."""
-    tid = hashlib.sha256(
+    """(trace_id_hex32, span_id_hex16). Events that carry propagated
+    trace context (util/trace_context stamped on the submit frame) keep
+    their ids — that is what links a nested chain into one trace. Events
+    without them (old-format frames, pre-tracing peers) fall back to the
+    seed's deterministic fabrication: task id as the trace key, span id
+    folding in the start time so retries of one task become distinct
+    spans on one trace."""
+    tid = e.get("trace_id") or hashlib.sha256(
         ("trace:" + e.get("task_id", "")).encode()).hexdigest()[:32]
-    sid = hashlib.sha256(
+    sid = e.get("span_id") or hashlib.sha256(
         f"span:{e.get('task_id', '')}:{e.get('start', 0)}".encode()
     ).hexdigest()[:16]
     return tid, sid
+
+
+def _resource_attributes() -> List[Dict[str, Any]]:
+    """OTLP resource attributes of the exporting process. service.name
+    stays first (consumers, incl. our own tests, key on position 0);
+    node/worker identity and chip count follow when known."""
+    attrs = [{"key": "service.name",
+              "value": {"stringValue": SERVICE_NAME}}]
+    try:
+        from ray_tpu.core.worker import global_worker
+        backend = getattr(global_worker, "backend", None)
+        node_id = getattr(backend, "local_node_id", "") if backend else ""
+        wid = getattr(global_worker, "worker_id", None)
+        if node_id:
+            attrs.append({"key": "rtpu.node_id",
+                          "value": {"stringValue": str(node_id)}})
+        if wid is not None:
+            attrs.append({"key": "rtpu.worker_id",
+                          "value": {"stringValue": wid.hex()}})
+    except Exception:  # noqa: BLE001 — resource identity is best-effort
+        pass
+    import os
+    chips = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    n_chips = len([c for c in chips.split(",") if c]) if chips else 0
+    attrs.append({"key": "rtpu.num_chips",
+                  "value": {"intValue": str(n_chips)}})
+    return attrs
 
 
 def events_to_otlp(events: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -48,7 +79,7 @@ def events_to_otlp(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         if e.get("kind") == "meta":
             continue
         trace_id, span_id = _span_ids(e)
-        spans.append({
+        span = {
             "traceId": trace_id,
             "spanId": span_id,
             "name": e.get("name", "task"),
@@ -63,19 +94,59 @@ def events_to_otlp(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                  "value": {"stringValue": e.get("kind", "task")}},
                 {"key": "rtpu.worker",
                  "value": {"stringValue": str(e.get("worker", ""))}},
+                {"key": "rtpu.node",
+                 "value": {"stringValue": str(e.get("node", ""))}},
             ],
-        })
+        }
+        if e.get("parent_span_id"):
+            span["parentSpanId"] = e["parent_span_id"]
+        spans.append(span)
     return {
         "resourceSpans": [{
-            "resource": {"attributes": [
-                {"key": "service.name",
-                 "value": {"stringValue": SERVICE_NAME}}]},
+            "resource": {"attributes": _resource_attributes()},
             "scopeSpans": [{
                 "scope": {"name": "ray_tpu.tasks"},
                 "spans": spans,
             }],
         }],
     }
+
+
+def assemble_trace(events: List[Dict[str, Any]],
+                   trace_id: str = "",
+                   task_id: str = "") -> List[Dict[str, Any]]:
+    """Assemble one trace's span tree from raw timeline events.
+
+    Select by trace_id, or by task_id (resolved to the trace its
+    execution span belongs to). Returns the root spans, each a dict of
+    the event's fields plus ``span_id`` / ``parent_span_id`` /
+    ``children`` (recursively) — the head-side trace assembly behind
+    ``python -m ray_tpu trace``."""
+    spans = []
+    for e in events:
+        if e.get("kind") == "meta":
+            continue
+        tid, sid = _span_ids(e)
+        spans.append({**e, "trace_id": tid, "span_id": sid,
+                      "parent_span_id": e.get("parent_span_id", ""),
+                      "children": []})
+    if not trace_id and task_id:
+        for s in spans:
+            if s.get("task_id") == task_id and s.get("kind") != "sched":
+                trace_id = s["trace_id"]
+                break
+    if not trace_id:
+        return []
+    mine = [s for s in spans if s["trace_id"] == trace_id]
+    by_id = {s["span_id"]: s for s in mine}
+    roots = []
+    for s in sorted(mine, key=lambda s: s.get("start", 0.0)):
+        parent = by_id.get(s["parent_span_id"])
+        if parent is not None and parent is not s:
+            parent["children"].append(s)
+        else:
+            roots.append(s)
+    return roots
 
 
 def _fetch_events() -> List[Dict[str, Any]]:

@@ -70,3 +70,43 @@ def test_cpu_weight_bounds(tmp_path):
     huge = mgr.create_worker_group("bb" * 16, num_cpus=500.0)
     assert open(os.path.join(huge, "cpu.weight")).read() == "10000"
     mgr.shutdown()
+
+
+def test_node_spawn_passes_cpu_request_to_cgroup(tmp_path, monkeypatch):
+    """The lease's CPU request reaches the worker leaf's cpu.weight via
+    NodeDaemon._spawn_worker (num_cpus was dead code in
+    create_worker_group until the node wired it through)."""
+    import threading
+
+    from ray_tpu.runtime import node as node_mod
+
+    root = make_fake_root(tmp_path)
+    mgr = CgroupManager("sess4", root=root)
+
+    class FakeProc:
+        pid = 4242
+        returncode = None
+
+        def wait(self):
+            threading.Event().wait()  # parked: daemon thread, test-scoped
+
+        def poll(self):
+            return None
+
+    monkeypatch.setattr(node_mod.subprocess, "Popen",
+                        lambda *a, **k: FakeProc())
+    nd = object.__new__(node_mod.NodeDaemon)
+    nd.session = "sess4"
+    nd.address = "127.0.0.1:0"
+    nd.head_addr = "127.0.0.1:0"
+    nd.shm_name = "shm"
+    nd.cgroups = mgr
+    nd.chips = None
+    nd._lock = threading.Lock()
+    nd._workers = {}
+    entry = nd._spawn_worker(num_cpus=1.5)
+    assert entry.cgroup_leaf is not None
+    assert open(os.path.join(entry.cgroup_leaf,
+                             "cpu.weight")).read() == "150"
+    assert open(os.path.join(entry.cgroup_leaf,
+                             "cgroup.procs")).read() == "4242"

@@ -75,6 +75,62 @@ def test_combined_malformed_reply_surfaces_error(echo_server):
     client.close()
 
 
+@pytest.fixture
+def eager_server():
+    """Handler that replies per slot via ctx.slot_ids/ctx.reply_to: slot 0
+    immediately, slot 1 only after `release` fires — then the done
+    marker. Models a worker flushing each task as it finishes."""
+    from ray_tpu.runtime.protocol import _COMBINED_DONE
+    state = {"release": threading.Event(), "slot_ids": None}
+
+    def handle_eager(payloads, ctx):
+        state["slot_ids"] = ctx.slot_ids
+        if ctx.slot_ids is None:  # old-format client: single reply
+            return [((p, "done"), None) for p in payloads]
+        ctx.reply_to(ctx.slot_ids[0], (payloads[0], "done"), None)
+
+        def later():
+            state["release"].wait(10)
+            ctx.reply_to(ctx.slot_ids[1], (payloads[1], "done"), None)
+            ctx.reply(_COMBINED_DONE)
+        threading.Thread(target=later, daemon=True).start()
+        return DEFERRED
+
+    srv = RpcServer({"eager": handle_eager}, name="eager-test")
+    yield srv, state
+    state["release"].set()
+    srv.stop()
+
+
+def test_combined_replies_flush_eagerly(eager_server):
+    """A completed slot's callback fires BEFORE the rest of the batch
+    finishes — the fix for nested-get deadlocks where task A waited on a
+    ref whose producing task B sat in the same withheld batch reply."""
+    srv, state = eager_server
+    client = RpcClient(srv.address)
+    got = {}
+    first = threading.Event()
+    done = threading.Event()
+
+    def cb(i, v, e):
+        got[i] = (v, e)
+        if i == 0:
+            first.set()
+        if len(got) == 2:
+            done.set()
+
+    client.call_combined_cb("eager", ["a", "b"], cb)
+    # slot 0 must arrive while slot 1 is still held open server-side
+    assert first.wait(10), "eager slot reply never fired"
+    assert got[0] == (("a", "done"), None)
+    assert not done.is_set()
+    state["release"].set()
+    assert done.wait(10), "batch never completed after release"
+    assert got[1] == (("b", "done"), None)
+    assert state["slot_ids"] is not None and len(state["slot_ids"]) == 2
+    client.close()
+
+
 def test_batch_error_isolation_end_to_end():
     """One failing task inside a burst must not poison its batchmates."""
     rt.init(num_cpus=2, _system_config={

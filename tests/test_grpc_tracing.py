@@ -92,6 +92,45 @@ def test_otlp_export(rt, tmp_path):
     assert svc["value"]["stringValue"] == "ray_tpu"
 
 
+def test_serve_router_replica_share_trace(rt):
+    """A Serve request is one trace: the router records a root span and
+    installs it as ambient, so the replica's actor-task span links to it
+    via parent_span_id across the process hop."""
+    import time
+
+    from ray_tpu.core.worker import global_worker
+
+    @serve.deployment(num_replicas=1)
+    class TracedDep:
+        def ping(self, x):
+            return x + 1
+
+    handle = serve.run(TracedDep.bind())
+    assert handle.ping.remote(1).result(timeout=60) == 2
+    deadline = time.monotonic() + 30
+    events, router, replica = [], None, None
+    while time.monotonic() < deadline:
+        events = global_worker.backend.head.call("timeline_dump")
+        router = next(
+            (e for e in events if e.get("kind") == "serve_router"
+             and "TracedDep" in e["name"]), None)
+        if router is not None:
+            replica = next(
+                (e for e in events if e.get("kind") == "actor_task"
+                 and e.get("trace_id") == router.get("trace_id")
+                 and e.get("parent_span_id") == router.get("span_id")),
+                None)
+        if router is not None and replica is not None:
+            break
+        time.sleep(0.5)
+    assert router is not None, \
+        [e["name"] for e in events if e.get("kind") == "serve_router"]
+    assert replica is not None, events
+    # same trace, replica span parented on the router span
+    assert router["trace_id"] == replica["trace_id"]
+    assert replica["parent_span_id"] == router["span_id"]
+
+
 def test_otlp_ids_deterministic():
     from ray_tpu.util.tracing import events_to_otlp
     ev = [{"name": "t", "task_id": "abc", "kind": "task",

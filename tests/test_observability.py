@@ -97,6 +97,139 @@ def test_task_timeline_records_spans(cluster_rt):
     assert all(t["ph"] == "X" and t["dur"] > 0 for t in trace)
 
 
+def test_nested_submit_single_trace(cluster_rt):
+    """Cross-process tracing: driver → outer task → nested inner task
+    (two worker processes) must export ONE trace whose spans link via
+    parent_span_id — the wire-propagated context, not name matching."""
+    @rt.remote
+    def trc_inner():
+        return 1
+
+    @rt.remote
+    def trc_outer():
+        return rt.get(trc_inner.remote()) + 1
+
+    assert rt.get(trc_outer.remote(), timeout=60) == 2
+    deadline = time.monotonic() + 20
+    events, outer, inner = [], None, None
+    while time.monotonic() < deadline:
+        events = global_worker.backend.head.call("timeline_dump")
+        outer = next((e for e in events if "trc_outer" in e["name"]
+                      and e.get("kind") == "task"), None)
+        inner = next((e for e in events if "trc_inner" in e["name"]
+                      and e.get("kind") == "task"), None)
+        if outer is not None and inner is not None:
+            break
+        time.sleep(0.3)
+    assert outer is not None and inner is not None, events
+    # one trace: the nested submit inherited the outer task's ambient
+    # context, across a separate worker process
+    assert outer.get("trace_id")
+    assert inner.get("trace_id") == outer["trace_id"]
+    assert inner["parent_span_id"] == outer["span_id"]
+    assert not outer.get("parent_span_id")  # driver-rooted
+    mine = [e for e in events if e.get("trace_id") == outer["trace_id"]]
+    assert sum(1 for e in mine if e.get("parent_span_id")) >= 3
+
+    # OTLP export carries the linkage verbatim
+    from ray_tpu.util import tracing
+    doc = tracing.events_to_otlp(mine)
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert {s["traceId"] for s in spans} == {outer["trace_id"]}
+    assert sum(1 for s in spans if s.get("parentSpanId")) >= 3
+
+    # head-side assembly: one root (the outer exec span), inner beneath it
+    roots = tracing.assemble_trace(events, trace_id=outer["trace_id"])
+    assert len(roots) == 1, roots
+    assert "trc_outer" in roots[0]["name"]
+
+    def names(span):
+        yield span["name"]
+        for c in span["children"]:
+            yield from names(c)
+    assert any("trc_inner" in n for n in names(roots[0]))
+    # selection by task_id resolves to the same trace
+    by_task = tracing.assemble_trace(events, task_id=inner["task_id"])
+    assert by_task and by_task[0]["trace_id"] == outer["trace_id"]
+
+
+def test_scheduler_phase_spans_and_queue_metrics(cluster_rt):
+    """Queueing delay is separable from execution: every exec span gets a
+    ::sched companion (submit→start, child of the exec span), the head
+    stamps lease:: phase events, and submit_to_start/queue_depth
+    aggregate in metrics_dump."""
+    @rt.remote
+    def phased():
+        time.sleep(0.02)
+        return 1
+
+    assert rt.get(phased.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 20
+    events, ex, sched = [], None, None
+    while time.monotonic() < deadline:
+        events = global_worker.backend.head.call("timeline_dump")
+        ex = next((e for e in events if "phased" in e["name"]
+                   and e.get("kind") == "task"), None)
+        sched = next((e for e in events if "phased" in e["name"]
+                      and e.get("kind") == "sched"), None)
+        if ex is not None and sched is not None:
+            break
+        time.sleep(0.3)
+    assert ex is not None and sched is not None, events
+    # the sched span ends where execution begins: queue time vs run time
+    assert sched["end"] <= ex["start"] + 1e-6
+    assert sched["start"] <= sched["end"]
+    assert sched["trace_id"] == ex["trace_id"]
+    assert sched["parent_span_id"] == ex["span_id"]
+    # head-side scheduler-phase events (lease grant path)
+    assert any(e.get("kind") == "sched" and e["name"].startswith("lease::")
+               and e.get("worker") == "head" for e in events), \
+        [e["name"] for e in events if e.get("kind") == "sched"]
+    # aggregate view at the head
+    agg = {}
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        agg = global_worker.backend.head.call("metrics_dump")
+        h = agg.get("submit_to_start")
+        if h and sum(v["n"] for v in h["values"].values()) >= 1:
+            break
+        time.sleep(0.3)
+    assert agg.get("submit_to_start", {}).get("type") == "histogram", \
+        sorted(agg)
+    assert "queue_depth" in agg
+
+
+def test_old_format_wire_frames_accepted():
+    """Mixed-version compat: a submit payload from a peer that predates
+    trace propagation (no trace/span/submit_ts fields) still parses, and
+    its events still export with the deterministic fabricated ids."""
+    from ray_tpu.core.ids import TaskID
+    from ray_tpu.core.task_spec import TaskSpec
+    from ray_tpu.runtime import wire
+    from ray_tpu.util import tracing
+
+    spec = TaskSpec(task_id=TaskID.from_random(), name="legacy",
+                    function_key=b"fn:x", resources={"CPU": 1.0})
+    payload, _ = wire.task_to_wire(spec, function_key="fn:x")
+    # new stamps present on the modern frame...
+    assert len(payload["trace_id"]) == 32
+    assert len(payload["span_id"]) == 16
+    # ...and absent on an old peer's frame — which must still be accepted
+    for k in ("trace_id", "span_id", "parent_span_id", "submit_ts",
+              "lease_ts"):
+        payload.pop(k, None)
+    back = wire.task_from_wire(payload)
+    assert back.name == "legacy"
+    assert back.task_id == spec.task_id
+    # OTLP export of a traceless event fabricates deterministic ids
+    e = {"name": "legacy", "task_id": "ab" * 8, "kind": "task",
+         "start": 1.0, "end": 2.0, "ok": True}
+    doc = tracing.events_to_otlp([e])
+    span = doc["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert len(span["traceId"]) == 32 and len(span["spanId"]) == 16
+    assert "parentSpanId" not in span
+
+
 def test_state_api_lists_tasks_and_objects(cluster_rt):
     """`list tasks` / `list objects` (reference: util/state/api.py:1011
     list_tasks, list_objects) — task spans from the head's event buffer,
