@@ -425,7 +425,13 @@ void handle_fast(Loop* L, Conn* c, uint64_t req_id, char* body,
             memcpy(&lkey, key, 8);
             FastLease& fl = kv->lease;
             auto hit = fl.held.find(lkey);
-            if (hit != fl.held.end()) {
+            // only the holding connection may re-pool: a stale or
+            // malicious release from another conn (e.g. a retried
+            // release racing a reconnect that re-acquired the key)
+            // would hand the same grant to two workers. status 0 sends
+            // the caller down the Python release_lease fallback, which
+            // validates ownership under the head lock.
+            if (hit != fl.held.end() && hit->second.conn_id == c->id) {
               fl.pools[hit->second.sig].emplace_back(
                   lkey, std::move(hit->second.grant));
               fl.held.erase(hit);

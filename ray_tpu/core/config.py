@@ -71,6 +71,8 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "log_to_driver": (bool, True, "forward worker logs to driver"),
     "event_buffer_size": (int, 10000, "task event buffer cap"),
     "metrics_export_period_s": (float, 5.0, "metrics push period"),
+    "hw_sampler_period_s": (float, 2.0, "node hardware sampler period (cpu/rss/cgroup/arena/tpu); 0 disables"),
+    "timeseries_ring_points": (int, 512, "points kept per (node, metric) hardware time series at the head"),
 }
 
 

@@ -11,8 +11,14 @@ what the `ray list`/state-API parity needs.
 
 Endpoints:
   GET /            html summary
+  GET /metrics     Prometheus text exposition (application metrics with
+                   cumulative-le histogram buckets + the newest hardware
+                   gauges per node — scrape this)
   GET /api/state   state_dump (nodes, actors, leases, placement groups)
   GET /api/metrics aggregated metrics
+  GET /api/timeseries?node=N&metric=M&last=K&latest=1
+                   hardware time-series rings (per node x metric; fed by
+                   the node daemons' 2s samplers)
   GET /api/timeline task spans (chrome-trace convertible)
   GET /api/jobs    submitted jobs
   GET /api/nodes   per-node agent stats (cpu/mem/disk/store/worker RSS —
@@ -85,6 +91,35 @@ class Dashboard:
                         self._send(200, _PAGE.encode(), "text/html")
                         return
                     parsed = urlparse(self.path)
+                    if parsed.path == "/metrics":
+                        # Prometheus scrape: app metrics (raw tag tuples)
+                        # + the newest hardware gauge of each live series
+                        from ray_tpu.util import prometheus
+                        agg = client.call("metrics_dump", {"raw": True},
+                                          timeout=10)
+                        hw = client.call("timeseries_dump",
+                                         {"latest": True,
+                                          "max_age_s": 120.0}, timeout=10)
+                        body = prometheus.render(agg, hw).encode()
+                        self._send(200, body,
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                        return
+                    if parsed.path == "/api/timeseries":
+                        q = parse_qs(parsed.query)
+                        payload = {
+                            "node": q.get("node", [""])[0],
+                            "metric": q.get("metric", [""])[0],
+                            "last": int(q.get("last", ["0"])[0] or 0),
+                        }
+                        if q.get("latest", [""])[0]:
+                            payload = {"latest": True,
+                                       "max_age_s": 120.0}
+                        data = client.call("timeseries_dump", payload,
+                                           timeout=10)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
                     if parsed.path == "/api/nodes":
                         # fan out: one hung-but-alive node must not
                         # stall the endpoint for 10s x N

@@ -919,13 +919,22 @@ class ClusterBackend:
                       for oid, counts in
                       self.worker.refcounter.snapshot(limit=50).items()]
             objects = {"tracked": tracked, "sample": sample}
-            if snap or events or tracked:
+            # accelerator memory rides the worker flush: only worker
+            # processes have jax live (the node daemon must never import
+            # it), so HBM gauges originate here, tagged per worker since
+            # device indices are process-local
+            from ray_tpu.runtime.hw_sampler import tpu_memory_samples
+            samples = tpu_memory_samples()
+            wid12 = self.worker.worker_id.hex()[:12]
+            for s in samples:
+                s.setdefault("tags", {})["worker"] = wid12
+            if snap or events or tracked or samples:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
                     "node": self.local_node_id,
                     "metrics": snap, "events": events,
-                    "objects": objects})
+                    "objects": objects, "samples": samples})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 

@@ -310,6 +310,11 @@ class Head:
         self._objects: Dict[str, dict] = {}  # worker -> object summary
         self._task_events: collections.deque = collections.deque(
             maxlen=cfg.event_buffer_size)
+        # hardware time series (node samplers push via telemetry_push):
+        # fixed rings per (node, metric, tags) — see util/timeseries.py
+        from ray_tpu.util.timeseries import TimeSeriesStore
+        self._timeseries = TimeSeriesStore(
+            maxlen=cfg.timeseries_ring_points)
         # unserviceable demand, deduped per (requester, shape): each
         # submitter polls its shape every ~0.2s, so per-poll appends would
         # over-count 25x per window (the autoscaler's demand signal;
@@ -348,6 +353,7 @@ class Head:
             "telemetry_push": self._h_telemetry_push,
             "metrics_dump": self._h_metrics_dump,
             "timeline_dump": self._h_timeline_dump,
+            "timeseries_dump": self._h_timeseries_dump,
             "autoscaler_state": self._h_autoscaler_state,
             "pubsub_publish": lambda p, c: self.pubsub.publish(
                 p["topic"], p["message"]),
@@ -1582,6 +1588,11 @@ class Head:
                 e["worker"] = p["worker"][:12]
                 e["node"] = p.get("node", "")
                 self._task_events.append(e)
+        if p.get("samples"):
+            # hardware gauges -> ring buffers (own lock; outside _lock so
+            # a big batch never stalls lease/actor RPCs)
+            self._timeseries.ingest(p.get("node") or p["worker"],
+                                    p["samples"])
         return True
 
     def _h_metrics_dump(self, p, ctx):
@@ -1594,11 +1605,26 @@ class Head:
             per_worker = {w: dict(e["snap"])
                           for w, e in self._metrics.items()}
         agg = aggregate(per_worker)
+        if p and p.get("raw"):
+            # tuple keys intact — the Prometheus renderer needs tag
+            # structure, and pickle-path callers carry tuples fine
+            return agg
         # tuple tag keys -> joined strings for wire/json friendliness
         for m in agg.values():
             m["values"] = {"|".join(k) if isinstance(k, tuple) else str(k): v
                            for k, v in m["values"].items()}
         return agg
+
+    def _h_timeseries_dump(self, p, ctx):
+        """Hardware ring-buffer dump (filters: node prefix, exact metric,
+        last N points per series; latest=True -> newest point only)."""
+        p = p or {}
+        if p.get("latest"):
+            return self._timeseries.latest(
+                max_age_s=p.get("max_age_s", 0.0))
+        return self._timeseries.dump(node=p.get("node", ""),
+                                     metric=p.get("metric", ""),
+                                     last=int(p.get("last", 0) or 0))
 
     def _h_timeline_dump(self, p, ctx):
         with self._lock:

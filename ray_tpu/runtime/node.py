@@ -156,6 +156,11 @@ class NodeDaemon:
             # policy, raylet/worker_killing_policy_retriable_fifo.h)
             threading.Thread(target=self._memory_monitor_loop, daemon=True,
                              name="node-mem-monitor").start()
+        if cfg.hw_sampler_period_s > 0:
+            # hardware telemetry: cpu%/RSS/cgroup/arena samples -> head
+            # ring buffers (reference: reporter_agent.py poll loop)
+            threading.Thread(target=self._hw_sampler_loop, daemon=True,
+                             name="node-hw-sampler").start()
         for _ in range(cfg.worker_pool_prestart):
             self._spawn_worker()
 
@@ -438,6 +443,40 @@ class NodeDaemon:
                     f"{cfg.memory_usage_threshold:.0%}")
                 last_victim = victims[0].worker_id
                 victim_deadline = time.monotonic() + 10.0
+
+    # --------------------------------------------------------- hw telemetry
+
+    def _hw_sampler_loop(self) -> None:
+        """Push one hardware-gauge batch per period over telemetry_push;
+        the head lands each batch in its per-(node, metric) ring buffers
+        (util/timeseries.py). Loss-tolerant by design: a down head just
+        drops samples until it returns."""
+        from ray_tpu.runtime.hw_sampler import HardwareSampler
+        period = config_mod.GlobalConfig.hw_sampler_period_s
+
+        def _worker_rows():
+            with self._lock:
+                return [{"worker_id": WorkerID(w.worker_id).hex(),
+                         "pid": w.proc.pid, "state": w.state}
+                        for w in self._workers.values()
+                        if w.state != "dead"]
+
+        sampler = HardwareSampler(
+            cgroup_dir=self.cgroups.slice_dir
+            if self.cgroups is not None else None,
+            workers=_worker_rows,
+            arena_stats=self.store.stats)
+        while not self._stopped.wait(period):
+            try:
+                samples = sampler.sample()
+                if samples:
+                    self._clients.get(self.head_addr).oneway(
+                        "telemetry_push", {
+                            "worker": f"node:{self.node_id[:12]}",
+                            "node": self.node_id, "role": "node",
+                            "samples": samples})
+            except Exception:  # noqa: BLE001 — head down: keep sampling
+                pass
 
     def _h_worker_ready(self, p, ctx):
         worker_id = p["worker_id"]

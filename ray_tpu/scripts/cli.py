@@ -12,6 +12,7 @@ Commands:
   start --address H:P [--num-cpus N]         add a node daemon to a cluster
   status [--address H:P]                     cluster resources + nodes
   list {nodes,actors,workers,placement-groups,objects} [--address H:P]
+  top [--watch] [--interval S]               node/worker hardware table
   stop [--address H:P]                       stop node daemons + head
 """
 
@@ -167,6 +168,121 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _render_top(client, address: str) -> str:
+    """One frame of `top`: nodes with hardware gauges, worker rows under
+    each node (data: state_dump + the newest hardware time-series point
+    per series + aggregated app metrics)."""
+    dump = client.call("state_dump", timeout=10)
+    latest = client.call("timeseries_dump",
+                         {"latest": True, "max_age_s": 30.0}, timeout=10)
+    metrics = client.call("metrics_dump", timeout=10)
+
+    node_gauges = {}   # node_id -> {metric: value}      (untagged series)
+    workers = {}       # node_id -> {wid: {cpu, rss, state}}
+    hbm = {}           # node_id -> {device: {used, limit}}
+    for s in latest:
+        nid, metric, tags = s["node"], s["metric"], s.get("tags") or {}
+        if metric in ("worker_cpu_percent", "worker_rss_bytes"):
+            w = workers.setdefault(nid, {}).setdefault(
+                tags.get("worker", "?"), {"state": tags.get("state", "")})
+            w["cpu" if metric == "worker_cpu_percent" else "rss"] = \
+                s["value"]
+            if tags.get("state"):
+                w["state"] = tags["state"]
+        elif metric in ("tpu_hbm_used_bytes", "tpu_hbm_limit_bytes"):
+            # device indices are process-local: key per (worker, device)
+            # so two workers' chip 0 don't collide in the per-node sum
+            d = hbm.setdefault(nid, {}).setdefault(
+                (tags.get("worker", ""), tags.get("device", "?")), {})
+            d["used" if metric == "tpu_hbm_used_bytes" else "limit"] = \
+                s["value"]
+        elif not tags:
+            node_gauges.setdefault(nid, {})[metric] = s["value"]
+
+    qd = metrics.get("queue_depth", {}).get("values", {})
+    queue_depth = sum(qd.values()) if qd else 0
+    inflight = metrics.get("serve_inflight_requests", {}).get("values", {})
+    nodes = dump["nodes"]
+    alive = [n for n in nodes if n["alive"]]
+    lines = [
+        f"ray_tpu top — {address}  "
+        f"nodes {len(alive)}/{len(nodes)}  leases {dump.get('leases', 0)}  "
+        f"queue_depth {queue_depth:g}"
+        + (f"  serve_inflight {sum(inflight.values()):g}" if inflight
+           else ""),
+        "",
+        f"{'NODE':<14}{'ALIVE':<7}{'CPU%':>6}  {'MEM':>19}  "
+        f"{'STORE':>19}  {'OBJS':>6}  {'HBM':>19}",
+    ]
+    # series are keyed by the daemon's full node_id; state rows carry the
+    # same id, but match by prefix so either side may be truncated
+    def _series_for(table, node_id):
+        for nid, v in table.items():
+            if node_id.startswith(nid) or nid.startswith(node_id):
+                return v
+        return {}
+
+    for n in sorted(nodes, key=lambda r: r["node_id"]):
+        g = _series_for(node_gauges, n["node_id"])
+        mem_u, mem_t = g.get("node_mem_used_bytes"), \
+            g.get("node_mem_total_bytes")
+        st_u, st_c = g.get("object_store_used_bytes"), \
+            g.get("object_store_capacity_bytes")
+        cpu = g.get("node_cpu_percent")
+        devs = _series_for(hbm, n["node_id"])
+        if devs:
+            used = sum(d.get("used", 0) for d in devs.values())
+            limit = sum(d.get("limit", 0) for d in devs.values())
+            hbm_s = f"{_fmt_bytes(used)}/{_fmt_bytes(limit)}"
+        else:
+            hbm_s = "-"
+        lines.append(
+            f"{n['node_id'][:12]:<14}"
+            f"{('yes' if n['alive'] else 'NO'):<7}"
+            f"{(f'{cpu:.1f}' if cpu is not None else '-'):>6}  "
+            f"{(f'{_fmt_bytes(mem_u)}/{_fmt_bytes(mem_t)}' if mem_u is not None and mem_t else '-'):>19}  "
+            f"{(f'{_fmt_bytes(st_u)}/{_fmt_bytes(st_c)}' if st_u is not None and st_c else '-'):>19}  "
+            f"{g.get('object_store_num_objects', 0):>6g}  "
+            f"{hbm_s:>19}")
+        rows = _series_for(workers, n["node_id"])
+        for wid in sorted(rows):
+            w = rows[wid]
+            cpu_s = f"{w['cpu']:.1f}" if "cpu" in w else "-"
+            rss_s = _fmt_bytes(w["rss"]) if "rss" in w else "-"
+            lines.append(f"  {wid:<12}  {w.get('state', ''):<8}"
+                         f"cpu {cpu_s:>6}  rss {rss_s:>9}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live node/worker hardware table (reference: `ray status` + the
+    dashboard node view, as a terminal table over the head's hardware
+    time-series rings)."""
+    address = load_address(args.address)
+    client = _client(address)
+    if not args.watch:
+        print(_render_top(client, address))
+        return 0
+    try:
+        while True:
+            frame = _render_top(client, address)
+            # clear + home, then the frame — repaint without scrollback spam
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_timeline(args) -> int:
     from ray_tpu.runtime.events import to_chrome_trace
     address = load_address(args.address)
@@ -279,6 +395,14 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("top", help="node/worker hardware table "
+                                    "(cpu/rss/hbm/store)")
+    sp.add_argument("--address")
+    sp.add_argument("--watch", action="store_true",
+                    help="repaint continuously until ctrl-c")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("timeline", help="export task timeline "
                                          "(chrome trace)")

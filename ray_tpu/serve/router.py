@@ -227,7 +227,7 @@ class Router:
         self._version = -1
         self._fetched_at = 0.0
         self._inflight: Dict[str, int] = {}  # replica actor id hex -> count
-        self._pending: list = []             # [(key, ref)] awaiting completion
+        self._pending: list = []   # [(key, ref, t0)] awaiting completion
         self._pending_cv = threading.Condition(self._lock)
         self._reaper_started = False
         # multiplex locality, learned from our own routing decisions (see
@@ -268,7 +268,7 @@ class Router:
                 # may never complete (replica killed, reply lost), and
                 # without this they'd be rescanned by every reap round
                 # forever (advisor r2 slow leak)
-                self._pending = [(k, r) for k, r in self._pending
+                self._pending = [(k, r, t0) for k, r, t0 in self._pending
                                  if k in live]
             self._fetched_at = now
 
@@ -316,6 +316,23 @@ class Router:
                     seen.pop(min(seen, key=seen.get))
             return chosen
 
+    def _note_metrics(self, latency_s: float = -1.0) -> None:
+        """Built-in serve metrics (L5 source wiring): the inflight gauge
+        tracks this router's total outstanding count; completions observe
+        the per-deployment latency histogram. Registered lazily and
+        swallowed on failure — routing must never depend on telemetry."""
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+            tags = {"deployment": self._name}
+            with self._lock:
+                total = sum(self._inflight.values())
+            metrics_mod.serve_inflight_gauge().set(total, tags=tags)
+            if latency_s >= 0:
+                metrics_mod.serve_request_latency_histogram().observe(
+                    latency_s, tags=tags)
+        except Exception:  # noqa: BLE001
+            pass
+
     def route_streaming(self, method_name: str, args: tuple, kwargs: dict,
                         model_id: str = "") -> DeploymentResponseGenerator:
         """Streamed call: items become consumable as the replica yields
@@ -333,10 +350,13 @@ class Router:
         key = replica.actor_id.hex()
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
+        self._note_metrics()
+        t0 = time.monotonic()
 
         def done():
             with self._lock:
                 self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+            self._note_metrics(latency_s=time.monotonic() - t0)
         try:
             gen = self._traced_remote(
                 method_name,
@@ -428,14 +448,16 @@ class Router:
                 self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
             raise
         self._watch_completion(key, ref)
+        self._note_metrics()
         return ref
 
     def _watch_completion(self, key: str, ref) -> None:
-        """Register (key, ref) with the single reaper thread, which
-        decrements the replica's in-flight count when the reply lands
-        (one thread per router, not per request)."""
+        """Register (key, ref, submit-time) with the single reaper
+        thread, which decrements the replica's in-flight count and
+        observes request latency when the reply lands (one thread per
+        router, not per request)."""
         with self._pending_cv:
-            self._pending.append((key, ref))
+            self._pending.append((key, ref, time.monotonic()))
             if not self._reaper_started:
                 self._reaper_started = True
                 threading.Thread(target=self._reap_loop, daemon=True,
@@ -449,7 +471,7 @@ class Router:
                     self._pending_cv.wait()
                 batch = list(self._pending)
             try:
-                done, _ = ray_tpu.wait([r for _, r in batch],
+                done, _ = ray_tpu.wait([r for _, r, _ in batch],
                                        num_returns=1, timeout=0.5,
                                        fetch_local=False)
             except Exception:  # noqa: BLE001 — e.g. during shutdown
@@ -458,15 +480,22 @@ class Router:
             if not done:
                 continue
             done_set = {d.id() for d in done}
+            now = time.monotonic()
+            latencies = []
             with self._pending_cv:
                 still = []
-                for key, ref in self._pending:
+                for key, ref, t0 in self._pending:
                     if ref.id() in done_set:
                         self._inflight[key] = max(
                             0, self._inflight.get(key, 1) - 1)
+                        latencies.append(now - t0)
                     else:
-                        still.append((key, ref))
+                        still.append((key, ref, t0))
                 self._pending = still
+            for lat in latencies:
+                self._note_metrics(latency_s=lat)
+            if not latencies:
+                self._note_metrics()
 
 
 def validate_timeout_s(value, default: float = 60.0) -> float:

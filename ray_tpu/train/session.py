@@ -7,7 +7,9 @@ metrics to the controller and persists checkpoints rank-0-only.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
@@ -34,6 +36,7 @@ class TrainContext:
         self.mesh_spec = mesh_spec
         self.reported: List[Dict[str, Any]] = []
         self.step = 0
+        self._last_report_t: Optional[float] = None
 
     # -- API used inside train_loop_per_worker ------------------------------
     def get_world_size(self) -> int:
@@ -67,6 +70,34 @@ class TrainContext:
             ckpt = self.ckpt_manager.save(checkpoint_tree, self.step, metrics)
             entry["_checkpoint_path"] = ckpt.path
         self.reported.append(entry)
+        if self.rank == 0:
+            self._emit_step_gauges(metrics)
+
+    def _emit_step_gauges(self, metrics: Dict[str, Any]) -> None:
+        """Built-in L5 train telemetry (rank 0): step time and throughput
+        from the wall clock between report() calls; MFU only when the loop
+        reports `flops_per_step` and peak FLOPs is known (RTPU_PEAK_FLOPS
+        env or a `peak_flops` metric). Rides the normal per-worker
+        telemetry flush — best-effort, never fails the training loop."""
+        now = time.monotonic()
+        prev, self._last_report_t = self._last_report_t, now
+        if prev is None:
+            return
+        dt = now - prev
+        if dt <= 0:
+            return
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+            metrics_mod.train_step_time_gauge().set(dt)
+            metrics_mod.train_throughput_gauge().set(1.0 / dt)
+            flops = metrics.get("flops_per_step")
+            peak = metrics.get("peak_flops") \
+                or float(os.environ.get("RTPU_PEAK_FLOPS", 0) or 0)
+            if flops and peak:
+                metrics_mod.train_mfu_gauge().set(
+                    float(flops) / (dt * float(peak)))
+        except Exception:  # noqa: BLE001
+            pass
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.restore_from

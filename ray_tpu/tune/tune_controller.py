@@ -111,6 +111,16 @@ class TuneController:
         except Exception:  # noqa: BLE001 — local mode w/o resource table
             return 4
 
+    @staticmethod
+    def _note_running_gauge(n: int) -> None:
+        """Built-in L5 metric: trials currently holding an actor in this
+        tuner process (best-effort — tuning never depends on telemetry)."""
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+            metrics_mod.tune_running_trials_gauge().set(n)
+        except Exception:  # noqa: BLE001
+            pass
+
     # ------------------------------------------------------------ main loop
     def run(self) -> List[Trial]:
         pending = [t for t in self.trials if t.status == TrialStatus.PENDING]
@@ -121,6 +131,7 @@ class TuneController:
                 t = pending.pop(0)
                 self._launch(t)
                 running.append(t)
+            self._note_running_gauge(len(running))
             ref_to_trial = {t.pending_ref: t for t in running}
             done, _ = ray_tpu.wait(list(ref_to_trial), num_returns=1,
                                    timeout=60)
@@ -148,6 +159,7 @@ class TuneController:
                 self._save_experiment_state()
                 continue
             self._on_trial_result(trial, result, pending, running)
+        self._note_running_gauge(0)
         self._save_experiment_state()
         return self.trials
 

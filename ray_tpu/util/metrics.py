@@ -187,6 +187,56 @@ def queue_depth_gauge() -> Gauge:
                  description="tasks pending without an assigned lease")
 
 
+def serve_request_latency_histogram() -> Histogram:
+    """Per-deployment request latency, submit at the router to reply
+    landed (reference: serve_deployment_processing_latency_ms — here in
+    seconds, observed caller-side so it includes queueing + transport)."""
+    return Histogram(
+        "serve_request_latency_s",
+        description="seconds from router submit to replica reply",
+        tag_keys=("deployment",))
+
+
+def serve_inflight_gauge() -> Gauge:
+    """Requests this process has routed to a deployment and not yet seen
+    complete (the router's own pow-2 in-flight estimate, summed across
+    replicas)."""
+    return Gauge("serve_inflight_requests",
+                 description="in-flight requests per deployment",
+                 tag_keys=("deployment",))
+
+
+def train_step_time_gauge() -> Gauge:
+    """Wall seconds between consecutive train.report calls on rank 0 —
+    the step clock every throughput/MFU number derives from (reference:
+    TorchTitan's built-in step-time telemetry as production table
+    stakes)."""
+    return Gauge("train_step_time_s",
+                 description="seconds per training step (rank 0)")
+
+
+def train_throughput_gauge() -> Gauge:
+    """Steps per second (rank 0); multiply by the run's tokens-per-step
+    for token throughput."""
+    return Gauge("train_steps_per_s",
+                 description="training steps per second (rank 0)")
+
+
+def train_mfu_gauge() -> Gauge:
+    """Model FLOPs utilization in [0, 1]: reported flops-per-step over
+    step_time x peak hardware FLOPs. Only emitted when the loop reports
+    a `flops_per_step` metric and peak FLOPs is known (RTPU_PEAK_FLOPS
+    env or a `peak_flops` metric)."""
+    return Gauge("train_mfu",
+                 description="model FLOPs utilization (0..1, rank 0)")
+
+
+def tune_running_trials_gauge() -> Gauge:
+    """Trials currently holding an actor in this tuner process."""
+    return Gauge("tune_running_trials",
+                 description="trials currently running")
+
+
 def aggregate(per_worker: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:
     """Merge worker snapshots: counters/histograms sum, gauges last-write.
     (head-side; reference: metrics agent → Prometheus aggregation)."""

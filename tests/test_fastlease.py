@@ -86,6 +86,50 @@ def test_pool_idle_drain_returns_capacity(cluster):
         "pooled grants never drained back to the cluster"
 
 
+def test_release_requires_holding_connection(cluster):
+    """FOP_LEASE_REL ownership check: only the connection that acquired a
+    grant may re-pool it. A foreign conn's release must return status 0
+    (sending it down the Python release_lease fallback, which validates
+    under the head lock) — otherwise a stale release racing a reconnect
+    could hand the same grant to two workers."""
+    import pickle
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.runtime import protocol_native as _pn
+    from ray_tpu.runtime.protocol import RpcClient
+
+    be = global_worker.backend
+    if not getattr(be, "_head_fast", False):
+        pytest.skip("head fastpath disabled in this build")
+    # arm the pool: a burst stocks 1-CPU grants, linger re-pools them
+    assert rt.get([tiny.remote(i) for i in range(50)]) == \
+        [i + 1 for i in range(50)]
+    sig = wire.lease_sig({"CPU": 1.0})
+    deadline = time.monotonic() + 15
+    status, blob = 0, b""
+    while time.monotonic() < deadline:
+        status, blob = be.head.call_fast(
+            _pn.FAST_LEASE_ACQ, key=_pn._U64.pack(sig), timeout=5)
+        if status == 1:
+            break
+        time.sleep(0.3)
+    assert status == 1, "native pool never stocked a 1-CPU grant"
+    fast_key = pickle.loads(blob)["fast_key"]
+
+    other = RpcClient(be.head_addr, name="chaos-release")
+    try:
+        st_foreign, _ = other.call_fast(
+            _pn.FAST_LEASE_REL, key=_pn._U64.pack(fast_key), timeout=5)
+        assert st_foreign == 0, \
+            "a foreign connection re-pooled another conn's held lease"
+    finally:
+        other.close()
+    # the true holder's release still re-pools
+    st_holder, _ = be.head.call_fast(
+        _pn.FAST_LEASE_REL, key=_pn._U64.pack(fast_key), timeout=5)
+    assert st_holder == 1, "holder's own release was refused"
+
+
 def test_lease_sig_stability():
     # head and client must agree on the shape signature across dict order
     a = wire.lease_sig({"CPU": 1.0, "custom": 2.0})
