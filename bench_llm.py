@@ -7,6 +7,12 @@ for — decode-step/TTFT numbers for the paged-KV engine).
 Model: ~202M-param Llama-shaped config (single v5e chip; the 8B config
 needs more HBM than one lite chip after KV pages). Prompt 128 tokens,
 batch 8 continuous decode.
+
+Prefix caching is ON (the engine default): COLD metrics therefore use
+DISTINCT prompts per sample — same length (so the same compile bucket
+and the same dispatch sequence as the original locked protocol), but
+different content, so no sample silently rides the prefix cache. Warm
+TTFT has its own metric (llm_ttft_prefix_hit).
 """
 
 import json
@@ -24,8 +30,13 @@ def main() -> None:
                       n_kv_heads=8, ffn_dim=2816, dtype=jnp.bfloat16)
     eng = InferenceEngine(cfg, page_size=32, total_pages=1024,
                           max_batch=8, max_seq_len=512, seed=0,
-                          decode_chunk=32)
-    prompt = [(7 * i + 3) % cfg.vocab_size for i in range(128)]
+                          decode_chunk=32, prefill_chunk=128)
+
+    def mk_prompt(j: int, n: int = 128):
+        """Distinct prompt per j (same length -> same bucket/programs)."""
+        return [(7 * i + 3 + 131 * j) % cfg.vocab_size for i in range(n)]
+
+    uniq = iter(range(1, 10_000))
 
     # --- TTFT: request arrival -> first token sampled (includes prefill).
     # LOCKED PROTOCOL (round-3 verdict: cross-run tunnel variance was
@@ -33,7 +44,7 @@ def main() -> None:
     # warmup, measure THREE consecutive groups of 7 samples each and
     # report every group's p50. The target is met only if ALL THREE p50s
     # beat it — the headline value is the WORST of the three.
-    eng.add_request(prompt, max_new_tokens=1)
+    eng.add_request(mk_prompt(0), max_new_tokens=1)
     t0 = time.perf_counter()
     eng.step()           # admit + prefill + first token
     ttft_cold = time.perf_counter() - t0   # includes compile
@@ -44,7 +55,7 @@ def main() -> None:
         samples = []
         for _ in range(7):
             t0 = time.perf_counter()
-            eng.add_request(prompt, max_new_tokens=1)
+            eng.add_request(mk_prompt(next(uniq)), max_new_tokens=1)
             eng.step()
             samples.append(time.perf_counter() - t0)
             while eng.has_work():
@@ -52,19 +63,48 @@ def main() -> None:
         group_p50s.append(sorted(samples)[len(samples) // 2])
     ttft = max(group_p50s)  # worst consecutive p50 carries the claim
 
+    # --- TTFT with a prefix-cache hit: a 96-token shared system prefix
+    # (3 full 32-token pages, page-aligned) + a distinct 32-token tail
+    # per request. After one cold request publishes the prefix pages,
+    # each hit only prefills its 32-token tail through the chunk program
+    # (attending to the cached pages). Same arrival->first-token clock
+    # as the locked cold protocol; p50 of 7.
+    system_prefix = [(11 * i + 5) % cfg.vocab_size for i in range(96)]
+
+    def mk_hit_prompt(j: int):
+        return system_prefix + [(13 * i + 7 + 97 * j) % cfg.vocab_size
+                                for i in range(32)]
+
+    eng.add_request(mk_hit_prompt(0), max_new_tokens=1)  # publish prefix
+    while eng.has_work():
+        eng.step()
+    eng.add_request(mk_hit_prompt(1), max_new_tokens=1)  # warm chunk jit
+    while eng.has_work():
+        eng.step()
+    hit_samples = []
+    for j in range(2, 9):
+        t0 = time.perf_counter()
+        eng.add_request(mk_hit_prompt(j), max_new_tokens=1)
+        eng.step()
+        hit_samples.append(time.perf_counter() - t0)
+        while eng.has_work():
+            eng.step()
+    ttft_hit = sorted(hit_samples)[len(hit_samples) // 2]
+    hit_cached = eng.stats["cached_tokens"]
+
     # --- TTFT under queue depth: 8 prompts arrive AT ONCE; per-request
     # TTFT = its own first-token time minus the shared arrival instant
     # (max_new_tokens=1 makes finish time == first-token time).
     # Warm the size-8 batched-prefill + grouped-write programs first
     # (same discipline as the solo protocol's compile warmup).
     for _ in range(8):
-        eng.add_request(prompt, max_new_tokens=1)
+        eng.add_request(mk_prompt(next(uniq)), max_new_tokens=1)
     while eng.has_work():
         eng.step()
     qd_samples = []
     for _ in range(3):
         t0 = time.perf_counter()
-        pending = {eng.add_request(prompt, max_new_tokens=1)
+        pending = {eng.add_request(mk_prompt(next(uniq)), max_new_tokens=1)
                    for _ in range(8)}
         ttfts = []
         while pending:
@@ -81,7 +121,7 @@ def main() -> None:
     # 8 decode chunks; the burst admits in ONE step now, so warm 2 steps
     # and measure the remaining 6 — warming 4 of 4 chunks measured zero)
     for _ in range(8):
-        eng.add_request(prompt, max_new_tokens=256)
+        eng.add_request(mk_prompt(next(uniq)), max_new_tokens=256)
     # warm the decode program + fill the batch
     for _ in range(2):
         eng.step()
@@ -93,15 +133,53 @@ def main() -> None:
     toks = eng.stats["decode_tokens"] - toks0
     steps = eng.stats["decode_steps"] - steps0
 
+    # --- decode throughput WHILE long prompts chunk-prefill into the
+    # free slots: 6 decoders (prompt 128, 256 new tokens) run while
+    # 384-token prompts (3 chunks of prefill_chunk=128 each) stream
+    # through the 2 remaining slots — the chunked scheduler interleaves
+    # them instead of stalling the batch for whole prefills. Reported:
+    # decode tokens/s over the mixed window (compare llm_decode_throughput
+    # for the interference cost).
+    def mk_long(j: int):
+        return [(17 * i + 9 + 103 * j) % cfg.vocab_size for i in range(384)]
+
+    eng.add_request(mk_long(0), max_new_tokens=1)   # warm the chunk jit
+    while eng.has_work():
+        eng.step()
+    decoders = {eng.add_request(mk_prompt(next(uniq)), max_new_tokens=256)
+                for _ in range(6)}
+    for _ in range(2):
+        eng.step()                                  # warm + fill batch
+    fed, n_longs = 1, 8
+    t0 = time.perf_counter()
+    d0, p0 = eng.stats["decode_tokens"], eng.stats["prefill_tokens"]
+    done: set = set()
+    while not decoders <= done:
+        if fed < n_longs and len(eng.waiting) + len(eng._chunking) < 2:
+            eng.add_request(mk_long(fed), max_new_tokens=1)
+            fed += 1
+        done.update(eng.step())
+    dt_mix = time.perf_counter() - t0
+    mix_decode = (eng.stats["decode_tokens"] - d0) / dt_mix
+    mix_prefill = (eng.stats["prefill_tokens"] - p0) / dt_mix
+
     out = [
         {"metric": "llm_ttft_p50", "value": round(ttft * 1000, 2),
          "unit": "ms", "vs_baseline": round(200.0 / (ttft * 1000), 2),
          "group_p50s_ms": [round(p * 1000, 2) for p in group_p50s],
          "meets_target": bool(all(p * 1000 < 200.0 for p in group_p50s)),
          "note": "WORST of 3 consecutive same-process p50s (7 samples "
-                 "each); 128-tok prompt prefill + argmax fused into one "
-                 "program = ONE scalar readback per TTFT; 202M model, "
-                 "1 chip; baseline = 200ms north-star target"},
+                 "each, distinct same-length prompts so none rides the "
+                 "prefix cache); 128-tok prompt prefill + argmax fused "
+                 "into one program = ONE scalar readback per TTFT; 202M "
+                 "model, 1 chip; baseline = 200ms north-star target"},
+        {"metric": "llm_ttft_prefix_hit", "value": round(ttft_hit * 1000, 2),
+         "unit": "ms", "vs_baseline": round(ttft / ttft_hit, 2),
+         "meets_target": bool(ttft_hit < ttft),
+         "note": "p50 of 7; 96-tok shared system prefix served from "
+                 "cached KV pages + 32-tok distinct tail chunk-prefilled "
+                 f"against them ({hit_cached} prompt tokens served from "
+                 "cache total); baseline = cold llm_ttft_p50"},
         {"metric": "llm_ttft_queued_mean", "value": round(ttft_q * 1000, 2),
          "unit": "ms", "vs_baseline": round(200.0 / (ttft_q * 1000), 2),
          "note": "mean per-request TTFT, 8 same-bucket prompts arriving "
@@ -111,7 +189,15 @@ def main() -> None:
          "unit": "tokens/s",
          "vs_baseline": None,
          "note": f"batch 8 continuous decode, {steps} steps, "
-                 f"{round(dt / max(steps, 1) * 1000, 2)} ms/step"},
+                 f"{round(dt / max(steps, 1) * 1000, 2)} ms/step; "
+                 "prefix cache + chunked-prefill scheduler enabled"},
+        {"metric": "llm_decode_under_prefill_load",
+         "value": round(mix_decode, 1), "unit": "tokens/s",
+         "vs_baseline": round(mix_decode / (toks / dt), 2),
+         "note": "decode tokens/s for 6 decoders while 384-tok prompts "
+                 "chunk-prefill (3x128-tok chunks) through the 2 free "
+                 f"slots ({round(mix_prefill, 0):.0f} prefill tok/s "
+                 "alongside); baseline = unloaded llm_decode_throughput"},
         {"metric": "llm_ttft_cold_compile", "value": round(ttft_cold, 2),
          "unit": "s", "vs_baseline": None,
          "note": "first-ever request incl. XLA compile"},

@@ -66,6 +66,12 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "max_lineage_bytes": (int, 64 * 1024**2, "lineage cache cap per owner"),
     # --- train / ml ---
     "train_health_poll_s": (float, 2.0, "train controller worker poll"),
+    # --- llm serving ---
+    "llm_prefix_cache": (bool, True, "share page-aligned prompt-prefix KV pages across requests (vLLM-style automatic prefix caching; LRU-evicted under allocator pressure)"),
+    "llm_prefill_chunk": (int, 512, "prompts (or uncached tails) longer than this prefill in chunks interleaved with decode steps, so one long prompt never stalls the running batch for a full prefill dispatch"),
+    "llm_step_token_budget": (int, 2048, "max prefill tokens scheduled per engine step (decode-priority continuous batching); 0 = unbounded"),
+    "llm_admit_lookahead": (int, 16, "waiting requests scanned past a non-admittable head for same-bucket/admissible prompts (head-of-line fix)"),
+    "llm_admit_age_cap_s": (float, 5.0, "a head request older than this stops lookahead skipping so freed pages go to it first (no starvation)"),
     # --- misc ---
     "session_dir": (str, "/tmp/ray_tpu", "root for session artifacts"),
     "log_to_driver": (bool, True, "forward worker logs to driver"),

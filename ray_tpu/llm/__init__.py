@@ -9,11 +9,11 @@ continuous-batching loop, llm/serve_llm.py the serve deployment.
 """
 
 from ray_tpu.llm.batch import LLMBatchPredictor, batch_inference
-from ray_tpu.llm.cache import PageAllocator, make_kv_cache
+from ray_tpu.llm.cache import PageAllocator, PrefixCache, make_kv_cache
 from ray_tpu.llm.engine import InferenceEngine
 from ray_tpu.llm.serve_llm import (LLMServer, build_llm_app,
                                    placement_for_engine)
 
 __all__ = ["InferenceEngine", "LLMServer", "PageAllocator",
-           "make_kv_cache", "batch_inference", "LLMBatchPredictor",
-           "build_llm_app", "placement_for_engine"]
+           "PrefixCache", "make_kv_cache", "batch_inference",
+           "LLMBatchPredictor", "build_llm_app", "placement_for_engine"]

@@ -1,44 +1,225 @@
 """Paged KV-cache bookkeeping (host side).
 
 Role-equivalent to vLLM's block manager (the reference delegates paging to
-vLLM — reference: llm/_internal/serve/deployments/llm/vllm/): a free-list
-page allocator over the device-resident page pool. Page 0 is reserved as
-the scratch target for inactive batch slots, so the fixed-shape decode
-step can always write *somewhere* without corrupting live sequences.
+vLLM — reference: llm/_internal/serve/deployments/llm/vllm/): a refcounted
+free-list page allocator over the device-resident page pool, plus a
+hash-indexed prefix cache over full KV pages (vLLM automatic prefix
+caching, rebuilt for the TPU paged pool). Page 0 is reserved as the
+scratch target for inactive batch slots, so the fixed-shape decode step
+can always write *somewhere* without corrupting live sequences.
+
+Prefix cache design:
+  - a prompt's FULL token blocks (page_size tokens each) are keyed by a
+    chain hash (block i's key folds in block i-1's key), so a lookup
+    walks the chain and stops at the first miss — only page-aligned
+    prefixes are shared, exactly vLLM's block-granular policy;
+  - a cached page referenced by a live sequence is read-only by
+    refcount: sequences never write into positions < their prompt
+    length except through copy-on-write (engine copies the page first);
+  - pages whose ONLY reference is the cache's are evictable, LRU order;
+    the engine evicts under allocator pressure, so the cache is free
+    HBM turned into hit-rate rather than reserved memory.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import collections
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 
 from ray_tpu.models.llama import LlamaConfig
 
+logger = logging.getLogger(__name__)
+
 SCRATCH_PAGE = 0
 
 
+class DoubleFreeError(RuntimeError):
+    """A page was freed more times than it was referenced."""
+
+
 class PageAllocator:
-    def __init__(self, total_pages: int):
+    """Refcounted page allocator.
+
+    ``alloc`` hands out pages at refcount 1; ``incref`` adds sharers
+    (prefix-cache hits map the same physical page into several
+    sequences); ``free`` DECREMENTS and only returns the page to the
+    free list when the count hits zero. Freeing an unreferenced page is
+    a double free: it would re-append the page and double-grant it,
+    silently cross-wiring two sequences' KV — raise under pytest,
+    log-and-skip in production (``strict_free`` overrides the default).
+    """
+
+    def __init__(self, total_pages: int,
+                 strict_free: Optional[bool] = None):
         if total_pages < 2:
             raise ValueError("need at least 2 pages (one is scratch)")
         self._free: List[int] = list(range(1, total_pages))
+        self._ref: Dict[int, int] = {}
         self.total_pages = total_pages
+        if strict_free is None:
+            strict_free = bool(os.environ.get("PYTEST_CURRENT_TEST"))
+        self.strict_free = strict_free
 
     @property
     def num_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         if n > len(self._free):
             return None
         out, self._free = self._free[:n], self._free[n:]
+        for p in out:
+            self._ref[p] = 1
         return out
+
+    def incref(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == SCRATCH_PAGE:
+                continue
+            if self._ref.get(p, 0) <= 0:
+                raise ValueError(f"incref of unallocated page {p}")
+            self._ref[p] += 1
 
     def free(self, pages: List[int]) -> None:
         for p in pages:
-            if p != SCRATCH_PAGE:
+            if p == SCRATCH_PAGE:
+                continue
+            ref = self._ref.get(p, 0)
+            if ref <= 0:
+                if self.strict_free:
+                    raise DoubleFreeError(f"double free of page {p}")
+                logger.warning("double free of page %d ignored", p)
+                continue
+            if ref == 1:
+                del self._ref[p]
                 self._free.append(p)
+            else:
+                self._ref[p] = ref - 1
+
+
+def hash_token_blocks(prompt: List[int], page_size: int) -> List[int]:
+    """Chain hashes of the prompt's FULL token blocks: block i's hash
+    folds in block i-1's, so equal hashes mean equal page-aligned
+    prefixes (vLLM's block hash chain)."""
+    out: List[int] = []
+    h = 0x9E3779B9
+    for i in range(len(prompt) // page_size):
+        block = tuple(prompt[i * page_size:(i + 1) * page_size])
+        h = hash((h, block))
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Hash-indexed table of full KV pages, with LRU eviction of pages
+    no live sequence references.
+
+    The cache holds ONE allocator reference per published page; a page
+    whose refcount drops to exactly that one (sequence finished) becomes
+    evictable. ``match`` increfs hit pages on behalf of the caller —
+    releasing them goes back through ``allocator.free`` +
+    ``note_release`` like any other sequence page.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        self._pages: Dict[int, int] = {}          # block hash -> page id
+        self._hash_of: Dict[int, int] = {}        # page id -> block hash
+        # evictable pages (cache holds the only reference), LRU order
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.lookups = 0
+        self.hits = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._pages)
+
+    @property
+    def num_evictable(self) -> int:
+        return len(self._lru)
+
+    def match(self, prompt: List[int]) -> Tuple[List[int], int, bool]:
+        """Longest cached page-aligned prefix of ``prompt``.
+
+        Returns ``(pages, matched_tokens, cow_needed)``; the matched
+        pages are INCREF'd for the caller. ``matched_tokens`` is capped
+        at ``len(prompt) - 1`` — the tail must compute at least the last
+        position's logits to sample the first token. When that cap cuts
+        into the last matched page (prompt length an exact page multiple
+        with every block cached), ``cow_needed`` is True: the tail
+        token's KV lands INSIDE that shared page, so the caller must
+        copy it before writing (copy-on-write).
+        """
+        self.lookups += 1
+        pages: List[int] = []
+        for h in hash_token_blocks(prompt, self.page_size):
+            p = self._pages.get(h)
+            if p is None:
+                break
+            pages.append(p)
+        if not pages:
+            return [], 0, False
+        matched = len(pages) * self.page_size
+        cow = False
+        if matched >= len(prompt):
+            matched = len(prompt) - 1
+            cow = True
+        self.hits += 1
+        self.hit_tokens += matched
+        for p in pages:
+            self._lru.pop(p, None)   # referenced again: not evictable
+        self.allocator.incref(pages)
+        return pages, matched, cow
+
+    def register(self, prompt: List[int], pages: List[int]) -> None:
+        """Publish a fully-prefilled prompt's full pages under their
+        chain hashes (one cache reference per newly published page).
+        Already-published hashes (the pages this prompt itself hit) are
+        left as-is."""
+        for i, h in enumerate(hash_token_blocks(prompt, self.page_size)):
+            if i >= len(pages):
+                break
+            if h in self._pages:
+                continue
+            p = pages[i]
+            if p in self._hash_of:
+                continue
+            self._pages[h] = p
+            self._hash_of[p] = h
+            self.allocator.incref([p])
+
+    def note_release(self, pages: List[int]) -> None:
+        """Call after ``allocator.free`` on a sequence's pages: cached
+        pages whose only remaining reference is the cache's become
+        LRU-evictable (most recently released = last evicted)."""
+        for p in pages:
+            if p in self._hash_of and self.allocator.refcount(p) == 1:
+                self._lru[p] = None
+                self._lru.move_to_end(p)
+
+    def evict(self, n: int) -> int:
+        """Drop up to ``n`` least-recently-used unreferenced cached
+        pages back to the allocator free list; returns how many freed."""
+        freed = 0
+        while freed < n and self._lru:
+            p, _ = self._lru.popitem(last=False)
+            h = self._hash_of.pop(p)
+            self._pages.pop(h, None)
+            self.allocator.free([p])
+            freed += 1
+            self.evictions += 1
+        return freed
 
 
 def make_kv_cache(cfg: LlamaConfig, total_pages: int, page_size: int,
@@ -54,7 +235,7 @@ class SequenceState:
     """Per-request paging state."""
 
     def __init__(self, request_id: str, prompt: List[int],
-                 max_new_tokens: int):
+                 max_new_tokens: int, enqueue_ts: float = 0.0):
         self.request_id = request_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
@@ -62,6 +243,14 @@ class SequenceState:
         self.pages: List[int] = []
         self.slot: Optional[int] = None     # decode batch slot
         self.done = False
+        self.enqueue_ts = enqueue_ts        # admission age (HOL fairness)
+        # chunked-prefill progress: prompt tokens whose KV is in pages
+        # (prefix-cache hits + chunks computed so far); prefilling=True
+        # keeps the sequence out of the decode batch until the tail is
+        # fully computed
+        self.num_computed = 0
+        self.cached_tokens = 0              # served from the prefix cache
+        self.prefilling = False
 
     @property
     def num_tokens(self) -> int:

@@ -12,11 +12,21 @@ admission, scheduling), rebuilt TPU-first:
     (prompt padded to the next power-of-two length bucket, group padded
     to a power-of-two size: compile count stays |len buckets| x |size
     buckets|), then each sequence's K/V is written into its pages and it
-    joins the decode batch — decode of running sequences is never
-    blocked for longer than one (batched) prefill, and a deep admission
-    queue amortizes the dispatch instead of serializing TTFT;
-  - pages allocate with one page of decode headroom and grow by one page
-    whenever the sequence fills its last page.
+    joins the decode batch;
+  - PREFIX CACHE: full prompt KV pages publish into a hash-indexed
+    table (llm/cache.py PrefixCache) — a new request whose prompt shares
+    a page-aligned prefix with a live or recently-finished sequence maps
+    those pages read-only (copy-on-write when the tail must write into a
+    shared page) and only prefills the tail, so thousand-user shared
+    system prompts stop paying full prefill;
+  - CHUNKED PREFILL: prompts (or uncached tails) longer than
+    prefill_chunk compute in bounded chunks (prefill_chunk_tok attends
+    to the prior paged KV) interleaved with decode steps under a
+    per-step token budget — decode-priority scheduling, so one 2k-token
+    prompt no longer stalls the running batch for a full prefill
+    dispatch;
+  - pages allocate refcounted with one page of decode headroom; under
+    allocator pressure the engine LRU-evicts unreferenced cached pages.
 """
 
 from __future__ import annotations
@@ -26,15 +36,16 @@ import functools
 import itertools
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.llm.cache import (SCRATCH_PAGE, PageAllocator, SequenceState,
-                               make_kv_cache)
-from ray_tpu.llm.model import decode_loop, prefill, prefill_many
+from ray_tpu.llm.cache import (SCRATCH_PAGE, PageAllocator, PrefixCache,
+                               SequenceState, make_kv_cache)
+from ray_tpu.llm.model import (copy_page, decode_loop, prefill,
+                               prefill_chunk_tok, prefill_many)
 from ray_tpu.models.llama import LlamaConfig, init_params
 
 
@@ -94,6 +105,14 @@ class _SingleChipFns:
     def prefill_many_tok(self, params, tokens, true_lens):
         return _prefill_many_tok(params, tokens, true_lens, self.cfg)
 
+    def prefill_chunk_tok(self, params, tokens, pages, prior_len,
+                          valid_len, k_cache, v_cache):
+        return prefill_chunk_tok(params, tokens, pages, prior_len,
+                                 valid_len, k_cache, v_cache, self.cfg)
+
+    def copy_page(self, k_cache, v_cache, src, dst):
+        return copy_page(k_cache, v_cache, src, dst)
+
     def write_prefill_pages(self, k_cache, v_cache, k_all, v_all,
                             true_len, pages, t_page):
         return _write_prefill_pages(k_cache, v_cache, k_all, v_all,
@@ -124,7 +143,13 @@ class InferenceEngine:
                  eos_token: Optional[int] = None, seed: int = 0,
                  decode_chunk: int = 8, prefill_batch: int = 4,
                  prefill_burst: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 step_token_budget: Optional[int] = None,
+                 admit_lookahead: Optional[int] = None,
+                 admit_age_cap_s: Optional[float] = None,
                  tp: int = 1, devices=None):
+        from ray_tpu.core.config import GlobalConfig
         self.cfg = cfg
         self.params = params if params is not None \
             else init_params(cfg, jax.random.PRNGKey(seed))
@@ -147,6 +172,19 @@ class InferenceEngine:
         self.prefill_batch = max(1, prefill_batch)
         self.prefill_burst = max_batch if prefill_burst is None \
             else max(1, prefill_burst)
+        # scheduler knobs (None -> GlobalConfig llm_* defaults)
+        self.prefill_chunk = max(
+            1, GlobalConfig.llm_prefill_chunk if prefill_chunk is None
+            else prefill_chunk)
+        self.step_token_budget = \
+            GlobalConfig.llm_step_token_budget \
+            if step_token_budget is None else step_token_budget
+        self.admit_lookahead = max(
+            1, GlobalConfig.llm_admit_lookahead if admit_lookahead is None
+            else admit_lookahead)
+        self.admit_age_cap_s = \
+            GlobalConfig.llm_admit_age_cap_s \
+            if admit_age_cap_s is None else admit_age_cap_s
         self.k_cache, self.v_cache = make_kv_cache(cfg, total_pages,
                                                    page_size)
         # tensor parallelism: tp>1 shards weights + kv-heads over a
@@ -164,8 +202,15 @@ class InferenceEngine:
         else:
             self._fns = _SingleChipFns(cfg, self.decode_chunk)
         self.allocator = PageAllocator(total_pages)
+        use_prefix = GlobalConfig.llm_prefix_cache \
+            if prefix_cache is None else prefix_cache
+        self.prefix: Optional[PrefixCache] = \
+            PrefixCache(self.allocator, page_size) if use_prefix else None
         self.waiting: List[SequenceState] = []
         self.running: List[SequenceState] = []
+        # admitted sequences still computing prompt KV in chunks; they
+        # hold a slot + pages but stay out of the decode batch
+        self._chunking: List[SequenceState] = []
         self._slots: List[Optional[SequenceState]] = [None] * max_batch
         self._req_ids = itertools.count()
         self._lock = threading.Lock()
@@ -176,7 +221,8 @@ class InferenceEngine:
         self._tokens = np.zeros(max_batch, np.int32)
         self.stats = {"prefill_tokens": 0, "prefill_dispatches": 0,
                       "decode_steps": 0, "decode_tokens": 0,
-                      "decode_dispatches": 0}
+                      "decode_dispatches": 0, "cached_tokens": 0,
+                      "chunk_dispatches": 0, "cow_copies": 0}
         self._finished_at_prefill: Dict[str, List[int]] = {}
         # tokens generated since the last drain_progress() call, per live
         # request — the incremental surface token streaming rides on
@@ -189,6 +235,20 @@ class InferenceEngine:
         # bounded: consumers pop, non-consumers age out
         self._finish_reasons: "collections.OrderedDict[str, str]" = \
             collections.OrderedDict()
+        # rid -> prompt tokens served from the prefix cache (OpenAI
+        # usage.prompt_tokens_details.cached_tokens); same bounding
+        self._cached_counts: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        # engine gauges on the PR-2 telemetry plane: worker flushes ship
+        # the process registry to the head -> /metrics + `ray_tpu top`
+        from ray_tpu.util import metrics as metrics_mod
+        self._g_kv_util = metrics_mod.llm_kv_page_utilization_gauge()
+        self._g_hit_rate = metrics_mod.llm_prefix_hit_rate_gauge()
+        self._g_prefill_tps = metrics_mod.llm_prefill_tokens_per_s_gauge()
+        self._g_decode_tps = metrics_mod.llm_decode_tokens_per_s_gauge()
+        self._g_queue = metrics_mod.llm_queue_depth_gauge()
+        self._metrics_ts = time.monotonic()
+        self._metrics_last = (0, 0)   # (prefill_tokens, decode_tokens)
 
     # ------------------------------------------------------------ requests
 
@@ -209,24 +269,28 @@ class InferenceEngine:
                 f"({self.allocator.total_pages - 1} allocatable)")
         rid = f"req-{next(self._req_ids)}"
         with self._lock:
-            self.waiting.append(SequenceState(rid, prompt, max_new_tokens))
+            self.waiting.append(SequenceState(
+                rid, prompt, max_new_tokens,
+                enqueue_ts=time.monotonic()))
         return rid
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self.waiting or self.running)
+            return bool(self.waiting or self.running or self._chunking)
 
     # ---------------------------------------------------------------- step
 
     def step(self) -> Dict[str, List[int]]:
-        """Admit a group of waiting requests (one batched prefill), then
-        one decode chunk for the whole running batch. Returns
-        {request_id: generated} for sequences that FINISHED this step."""
-        self._admit()
+        """One scheduler step: bounded prefill work (chunk continuations
+        + admissions, under the step token budget), then one decode
+        chunk for the whole running batch. Returns {request_id:
+        generated} for sequences that FINISHED this step."""
+        self._schedule_prefill()
         finished = self._decode()
         if self._finished_at_prefill:
             finished.update(self._finished_at_prefill)
             self._finished_at_prefill = {}
+        self._update_metrics()
         return finished
 
     def _free_slot(self) -> Optional[int]:
@@ -235,44 +299,159 @@ class InferenceEngine:
                 return i
         return None
 
-    def _admit(self) -> None:
-        """Admit a GROUP of same-length-bucket waiting requests in one
-        batched prefill dispatch (up to prefill_batch, bounded by free
-        slots and cache pages). Under a deep queue this amortizes the
-        per-dispatch cost that made TTFT grow linearly with queue depth;
-        a lone request still rides the single-prompt program."""
-        group: List = []   # (seq, slot, pages)
+    # ---------------------------------------------------------- scheduling
+
+    def _schedule_prefill(self) -> None:
+        """Decode-priority prefill scheduling: at most step_token_budget
+        prompt tokens compute per step, so the decode chunk that follows
+        is never starved behind unbounded prefill work. In-flight
+        chunked prefills continue first (they already hold pages and
+        slots), then new requests admit with what remains."""
+        budget = self.step_token_budget \
+            if self.step_token_budget > 0 else (1 << 30)
+        spent = 0
+        inflight = list(self._chunking)
+        for seq in inflight:
+            if spent >= budget:
+                break
+            spent += self._run_chunk(seq, budget - spent)
+        if spent >= budget:
+            return
+        spent += self._admit(budget - spent)
+        # first chunk of freshly admitted chunked sequences rides the
+        # same step (a prefix-hit tail should not wait a step for TTFT)
+        for seq in [s for s in self._chunking if s not in inflight]:
+            if spent >= budget:
+                break
+            spent += self._run_chunk(seq, budget - spent)
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate, LRU-evicting unreferenced prefix-cache pages under
+        pressure — cached pages are free HBM, not reserved memory."""
+        pages = self.allocator.alloc(n)
+        if pages is None and self.prefix is not None:
+            short = n - self.allocator.num_free
+            if self.prefix.evict(short) >= short:
+                pages = self.allocator.alloc(n)
+        return pages
+
+    def _release_pages(self, pages: List[int]) -> None:
+        self.allocator.free(pages)
+        if self.prefix is not None:
+            self.prefix.note_release(pages)
+
+    def _unmatch(self, matched_pages: List[int]) -> None:
+        """Undo a PrefixCache.match whose sequence did not admit."""
+        if matched_pages:
+            self._release_pages(matched_pages)
+
+    def _admit(self, budget: int) -> int:
+        """Admit waiting requests, two paths:
+
+        FAST: no cached prefix and the prompt fits one prefill_chunk —
+        same-length-bucket requests group into ONE batched prefill
+        dispatch (up to prefill_batch/prefill_burst), the original
+        TTFT-optimized path.
+
+        CHUNKED: a cached prefix exists (the tail must attend to prior
+        pages) or the prompt exceeds prefill_chunk — the sequence
+        reserves a slot + pages (copy-on-write if its tail writes into a
+        shared page) and its KV computes chunk-by-chunk interleaved with
+        decode steps.
+
+        Head-of-line fix: the scan continues past non-admissible
+        requests (different compile bucket, no pages) through a bounded
+        lookahead window instead of breaking at the first mismatch — one
+        long prompt at the head no longer starves short prompts behind
+        it. Aging guard: once the head has waited admit_age_cap_s, a
+        head that fails for MEMORY stops the scan, so freed pages reach
+        it instead of being re-captured by younger requests forever.
+
+        Returns fast-path prompt tokens admitted (counted against the
+        step budget; chunked tails are budgeted as their chunks run)."""
+        group: List[Tuple[SequenceState, int, List[int]]] = []
+        chunked: List[Tuple[SequenceState, List[int], List[int], bool]] = []
+        spent = 0
         with self._lock:
             if not self.waiting:
-                return
-            # group size: prefill_batch while sequences are DECODING (a
-            # bigger group would stall their next chunk longer), but with
-            # an idle decode batch nothing is blocked — admit up to every
-            # free slot so a burst of arrivals rides ONE dispatch and
-            # every request's TTFT is the same single prefill (the
-            # concurrent-arrival case the queued-TTFT target measures)
+                return 0
+            now = time.monotonic()
             cap = self.prefill_batch if self.running else self.prefill_burst
-            bucket = _bucket(len(self.waiting[0].prompt))
-            taken: List[int] = []
-            while self.waiting and len(group) < cap:
-                seq = self.waiting[0]
-                if _bucket(len(seq.prompt)) != bucket:
-                    break  # different compile bucket: next step's group
-                slot = next((i for i, s in enumerate(self._slots)
-                             if s is None and i not in taken), None)
-                if slot is None:
+            head = self.waiting[0]
+            head_aged = (now - head.enqueue_ts) > self.admit_age_cap_s
+            bucket: Optional[int] = None
+            free_slots = [i for i, s in enumerate(self._slots)
+                          if s is None]
+            for seq in list(self.waiting[:self.admit_lookahead]):
+                if not free_slots or spent >= budget:
                     break
-                pages = self.allocator.alloc(
-                    seq.pages_needed(self.page_size, headroom=1))
-                if pages is None:
-                    break  # no memory: wait for a finish to free pages
-                self.waiting.pop(0)
-                taken.append(slot)
-                group.append((seq, slot, pages))
+                matched_pages: List[int] = []
+                matched, cow = 0, False
+                if self.prefix is not None:
+                    matched_pages, matched, cow = \
+                        self.prefix.match(seq.prompt)
+                tail = len(seq.prompt) - matched
+                if matched == 0 and tail <= self.prefill_chunk:
+                    # ---- fast path: whole-prompt bucketed group prefill
+                    if len(group) >= cap:
+                        continue
+                    b = _bucket(len(seq.prompt))
+                    if bucket is not None and b != bucket:
+                        continue  # different compile bucket: scan on
+                    pages = self._alloc_pages(
+                        seq.pages_needed(self.page_size, headroom=1))
+                    if pages is None:
+                        if seq is head and head_aged:
+                            break  # aged head waits for memory first
+                        continue
+                    # the group's bucket is claimed by the first prompt
+                    # that actually ADMITS (a memory-blocked prompt must
+                    # not poison the bucket for the rest of the scan)
+                    bucket = b
+                    slot = free_slots.pop(0)
+                    self.waiting.remove(seq)
+                    group.append((seq, slot, pages))
+                    spent += len(seq.prompt)
+                else:
+                    # ---- chunked path: slot + pages now, KV in chunks
+                    need = seq.pages_needed(self.page_size, headroom=1) \
+                        - len(matched_pages) + (1 if cow else 0)
+                    tail_pages = self._alloc_pages(need)
+                    if tail_pages is None:
+                        self._unmatch(matched_pages)
+                        if seq is head and head_aged:
+                            break
+                        continue
+                    slot = free_slots.pop(0)
+                    self.waiting.remove(seq)
+                    seq.slot = slot
+                    seq.prefilling = True
+                    seq.num_computed = matched
+                    seq.cached_tokens = matched
+                    self._slots[slot] = seq
+                    chunked.append((seq, matched_pages, tail_pages, cow))
+        for seq, matched_pages, tail_pages, cow in chunked:
+            if cow:
+                # tail writes land inside the last shared page: copy it
+                # on device, then drop our reference to the original
+                cow_page = tail_pages.pop(0)
+                orig = matched_pages[-1]
+                self.k_cache, self.v_cache = self._fns.copy_page(
+                    self.k_cache, self.v_cache, jnp.int32(orig),
+                    jnp.int32(cow_page))
+                self._release_pages([orig])
+                matched_pages = matched_pages[:-1] + [cow_page]
+                self.stats["cow_copies"] += 1
+            seq.pages = matched_pages + tail_pages
+            self.stats["cached_tokens"] += seq.cached_tokens
+            self._note_cached(seq.request_id, seq.cached_tokens)
+            self._chunking.append(seq)
         if not group:
-            return
-        Tpad = bucket
+            return spent
+        Tpad = _bucket(max(len(s.prompt) for s, _, _ in group))
         self.stats["prefill_dispatches"] += 1
+        for seq, _, _ in group:
+            self.stats["prefill_tokens"] += len(seq.prompt)
         if len(group) == 1:
             seq, slot, pages = group[0]
             T = len(seq.prompt)
@@ -281,7 +460,7 @@ class InferenceEngine:
             tok, k_all, v_all = self._fns.prefill_tok(
                 self.params, jnp.asarray(tokens), jnp.int32(T))
             self._postfill(seq, slot, pages, int(tok), k_all, v_all)
-            return
+            return spent
         # batched path: pad the group to a power-of-two size so compile
         # count stays |size buckets| x |length buckets|, not one program
         # per exact group size
@@ -313,6 +492,34 @@ class InferenceEngine:
             jnp.asarray(pages_n), t_page)
         for i, (seq, slot, pages) in enumerate(group):
             self._postfill_book(seq, slot, pages, int(first_toks[i]))
+        return spent
+
+    def _run_chunk(self, seq: SequenceState, allowance: int) -> int:
+        """Compute the next prefill chunk (at most prefill_chunk /
+        allowance tokens) for one chunked sequence; on the final chunk
+        the fused argmax's token joins it to the decode batch. Returns
+        tokens computed."""
+        remaining = len(seq.prompt) - seq.num_computed
+        C = min(self.prefill_chunk, remaining, allowance)
+        if C <= 0:
+            return 0
+        Cpad = _bucket(C)
+        tokens = np.zeros((1, Cpad), np.int32)
+        tokens[0, :C] = seq.prompt[seq.num_computed:seq.num_computed + C]
+        row = np.full(self.max_pages_per_seq, SCRATCH_PAGE, np.int32)
+        row[:len(seq.pages)] = seq.pages
+        tok, self.k_cache, self.v_cache = self._fns.prefill_chunk_tok(
+            self.params, jnp.asarray(tokens), jnp.asarray(row),
+            jnp.int32(seq.num_computed), jnp.int32(C),
+            self.k_cache, self.v_cache)
+        seq.num_computed += C
+        self.stats["prefill_tokens"] += C
+        self.stats["chunk_dispatches"] += 1
+        if seq.num_computed >= len(seq.prompt):
+            self._chunking.remove(seq)
+            seq.prefilling = False
+            self._postfill_book(seq, seq.slot, seq.pages, int(tok))
+        return C
 
     def _postfill(self, seq: SequenceState, slot: int, pages: List[int],
                   first_tok: int, k_all, v_all) -> None:
@@ -328,11 +535,16 @@ class InferenceEngine:
 
     def _postfill_book(self, seq: SequenceState, slot: int,
                        pages: List[int], first_tok: int) -> None:
-        """Post-prefill bookkeeping: either finish immediately (EOS /
-        1-token budget) or join the decode batch with the already-sampled
-        first token."""
+        """Post-prefill bookkeeping: publish full prompt pages into the
+        prefix cache, then either finish immediately (EOS / 1-token
+        budget) or join the decode batch with the already-sampled first
+        token."""
         seq.pages = pages
-        self.stats["prefill_tokens"] += len(seq.prompt)
+        if self.prefix is not None:
+            # registering BEFORE a possible immediate finish keeps
+            # recently-finished prompts reusable (their pages go
+            # evictable-LRU, not back to the free list)
+            self.prefix.register(seq.prompt, pages)
         done_now = seq.max_new_tokens <= 1 \
             or (self.eos_token is not None and first_tok == self.eos_token)
         if done_now:
@@ -347,7 +559,11 @@ class InferenceEngine:
                 self._progress.setdefault(seq.request_id, []).extend(out)
             self._note_finish(seq.request_id,
                               "stop" if not out else "length")
-            self.allocator.free(pages)
+            self._release_pages(pages)
+            if seq.slot is not None:    # chunked path reserved a slot
+                self._slots[seq.slot] = None
+                self._page_table[seq.slot, :] = SCRATCH_PAGE
+                seq.slot = None
             return
         seq.generated.append(first_tok)
         if self.track_progress:
@@ -367,7 +583,7 @@ class InferenceEngine:
             self._note_finish(seq.request_id, "length")
         seq.done = True
         finished[seq.request_id] = list(seq.generated)
-        self.allocator.free(seq.pages)
+        self._release_pages(seq.pages)
         self._slots[slot] = None
         self._page_table[slot, :] = SCRATCH_PAGE
         with self._lock:
@@ -382,7 +598,7 @@ class InferenceEngine:
                                     headroom=self.decode_chunk),
                    self.max_pages_per_seq)
         while len(seq.pages) < need:
-            extra = self.allocator.alloc(1)
+            extra = self._alloc_pages(1)
             if extra is None:
                 # out of cache: finish the sequence early (MVP policy;
                 # vLLM would preempt/swap instead)
@@ -395,10 +611,14 @@ class InferenceEngine:
     def _decode(self) -> Dict[str, List[int]]:
         finished: Dict[str, List[int]] = {}
         for slot, seq in list(enumerate(self._slots)):
-            if seq is not None:
+            if seq is not None and not seq.prefilling:
                 self._ensure_chunk_pages(slot, seq, finished)
+        # chunk-prefilling sequences hold slots but stay out of the
+        # decode batch; their host page_table rows remain SCRATCH until
+        # they join, so the fixed-shape decode step cannot touch their
+        # pages
         active = [(i, s) for i, s in enumerate(self._slots)
-                  if s is not None]
+                  if s is not None and not s.prefilling]
         if not active:
             return finished
         K = self.decode_chunk
@@ -447,6 +667,42 @@ class InferenceEngine:
     def finish_reason(self, rid: str) -> str:
         """Why rid stopped: "stop" (EOS) or "length" (token budget)."""
         return self._finish_reasons.pop(rid, "length")
+
+    def _note_cached(self, rid: str, n: int) -> None:
+        if n <= 0:
+            return
+        self._cached_counts[rid] = n
+        while len(self._cached_counts) > 1024:
+            self._cached_counts.popitem(last=False)
+
+    def cached_tokens(self, rid: str) -> int:
+        """Prompt tokens rid served from the prefix cache (pops)."""
+        return self._cached_counts.pop(rid, 0)
+
+    # ------------------------------------------------------------- metrics
+
+    def _update_metrics(self, force: bool = False) -> None:
+        """Engine gauges for the telemetry plane, throttled to ~1/s (the
+        worker telemetry flush ships this process's registry to the
+        head: /metrics exposition + `python -m ray_tpu top`)."""
+        now = time.monotonic()
+        dt = now - self._metrics_ts
+        if dt < 1.0 and not force:
+            return
+        pf, dc = self.stats["prefill_tokens"], self.stats["decode_tokens"]
+        lp, ld = self._metrics_last
+        self._metrics_last = (pf, dc)
+        self._metrics_ts = now
+        allocatable = self.allocator.total_pages - 1   # page 0 = scratch
+        self._g_kv_util.set(1.0 - self.allocator.num_free / allocatable)
+        cached = self.stats["cached_tokens"]
+        denom = cached + pf
+        self._g_hit_rate.set(cached / denom if denom else 0.0)
+        if dt > 0:
+            self._g_prefill_tps.set((pf - lp) / dt)
+            self._g_decode_tps.set((dc - ld) / dt)
+        with self._lock:
+            self._g_queue.set(len(self.waiting))
 
     # ------------------------------------------------------------ blocking
 

@@ -178,6 +178,90 @@ def _decode_body(params: Params, tokens: jax.Array, positions: jax.Array,
     return logits, k_cache, v_cache
 
 
+def _prefill_chunk_body(params: Params, tokens: jax.Array,
+                        pages: jax.Array, prior_len: jax.Array,
+                        valid_len: jax.Array, k_cache: jax.Array,
+                        v_cache: jax.Array, cfg: LlamaConfig,
+                        tp_axis: Optional[str] = None,
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One CHUNK of a prompt, attending to the prior paged KV.
+
+    tokens [1, Cpad] (chunk padded to its length bucket); pages
+    [max_pages] the sequence's page row (scratch-padded); prior_len:
+    tokens already resident in the pages (prefix-cache hits + earlier
+    chunks); valid_len: real tokens in this chunk. Returns (next_tok,
+    k_cache, v_cache): argmax logits at the chunk's last valid position,
+    fused in-program like _prefill_tok so a final chunk's first token is
+    one scalar readback.
+
+    The pool is touched exactly twice, OUTSIDE the layer scan: one
+    gather of this sequence's page rows before it, one write_chunk_kv
+    scatter of every layer's chunk K/V after it. Inside the scan,
+    attention sees the gathered prior (positions < prior_len) plus the
+    chunk's in-flight K/V, same as `prefill` never touching the pool
+    mid-program. Threading the pool through the scan as carries/ys
+    instead makes XLA stack full-pool copies per layer — measured
+    pool-size-proportional, ~7x a whole 128-token prefill.
+
+    This is the chunked-prefill workhorse: a 2k-token prompt becomes
+    several bounded dispatches interleaved with decode steps instead of
+    one monolithic prefill stalling the running batch.
+    """
+    from ray_tpu.ops.paged_attention import (paged_chunk_attention,
+                                             write_chunk_kv)
+    B, C = tokens.shape
+    cd = cfg.dtype
+    x = params["embed"].astype(cd)[tokens]          # [1, C, d]
+    positions = prior_len + jnp.arange(C)
+    k_prior = k_cache[:, pages]                     # [L, n, Hkv, ps, D]
+    v_prior = v_cache[:, pages]
+
+    def layer(x, inp):
+        lp, kp, vp = inp
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(lp, h, cfg)          # [1, C, H(_local), D]
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        o = paged_chunk_attention(q[0], kp, vp, k[0], v[0], prior_len)
+        o = o.reshape(B, C, -1).astype(cd)
+        x = x + _maybe_psum(o @ lp["wo"].astype(cd), tp_axis)
+        x = _mlp(lp, x, cfg, tp_axis)
+        return x, (k[0], v[0])
+
+    x, (k_all, v_all) = lax.scan(
+        layer, x, (params["layers"], k_prior, v_prior))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    xlast = lax.dynamic_index_in_dim(x[0], valid_len - 1, axis=0,
+                                     keepdims=False)
+    logits = jnp.einsum("d,vd->v", xlast.astype(cd),
+                        params["embed"].astype(cd),
+                        preferred_element_type=jnp.float32)
+    k_cache, v_cache = write_chunk_kv(k_cache, v_cache, k_all, v_all,
+                                      pages, prior_len, valid_len)
+    return jnp.argmax(logits), k_cache, v_cache
+
+
+#: single-chip jit of the chunk program (compiles once per chunk bucket)
+prefill_chunk_tok = functools.partial(
+    jax.jit, static_argnames=("cfg", "tp_axis"),
+    donate_argnames=("k_cache", "v_cache"))(_prefill_chunk_body)
+
+
+def _copy_page_body(k_cache, v_cache, src, dst):
+    """Copy-on-write: duplicate one page's K/V across all layers (a
+    prefix-hit sequence about to write into a shared page copies it
+    first). Plain body so tp.py can shard_map it over local head shards."""
+    k_cache = k_cache.at[:, dst].set(
+        lax.dynamic_index_in_dim(k_cache, src, axis=1, keepdims=False))
+    v_cache = v_cache.at[:, dst].set(
+        lax.dynamic_index_in_dim(v_cache, src, axis=1, keepdims=False))
+    return k_cache, v_cache
+
+
+copy_page = functools.partial(
+    jax.jit, donate_argnames=("k_cache", "v_cache"))(_copy_page_body)
+
+
 def stage_prefill_kv(k_cache, v_cache, k_all, v_all, true_len, pages,
                      t_page: int):
     """Zero padding positions, pad/slice to t_page tokens, scatter the

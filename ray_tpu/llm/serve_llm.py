@@ -126,7 +126,8 @@ class LLMServer:
                 yield {"token_ids": item, "request_id": rid}
             yield {"done": True, "request_id": rid,
                    "token_ids": list(produced),
-                   "finish_reason": self.engine.finish_reason(rid)}
+                   "finish_reason": self.engine.finish_reason(rid),
+                   "cached_tokens": self.engine.cached_tokens(rid)}
         finally:
             with self._lock:
                 self._token_qs.pop(rid, None)
@@ -151,7 +152,8 @@ class LLMServer:
     # --------------------------------------------------------- OpenAI API
 
     def _completion_body(self, rid: str, token_ids: List[int],
-                         n_prompt: int, finish_reason: str) -> Dict[str, Any]:
+                         n_prompt: int, finish_reason: str,
+                         cached: int = 0) -> Dict[str, Any]:
         return {
             "id": f"cmpl-{rid}",
             "object": "text_completion",
@@ -162,9 +164,12 @@ class LLMServer:
                          "token_ids": list(token_ids),
                          "logprobs": None,
                          "finish_reason": finish_reason}],
+            # prompt_tokens_details.cached_tokens: prompt tokens served
+            # from the engine's prefix cache (OpenAI cached-tokens field)
             "usage": {"prompt_tokens": n_prompt,
                       "completion_tokens": len(token_ids),
-                      "total_tokens": n_prompt + len(token_ids)},
+                      "total_tokens": n_prompt + len(token_ids),
+                      "prompt_tokens_details": {"cached_tokens": cached}},
         }
 
     def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
@@ -176,7 +181,8 @@ class LLMServer:
                              "max_tokens": request.get("max_tokens", 32)})
         return self._completion_body(
             out["request_id"], out["token_ids"], len(prompt),
-            self.engine.finish_reason(out["request_id"]))
+            self.engine.finish_reason(out["request_id"]),
+            self.engine.cached_tokens(out["request_id"]))
 
     def completions_stream(self, request: Dict[str, Any]
                            ) -> Iterator[Dict[str, Any]]:
@@ -191,7 +197,8 @@ class LLMServer:
             if item.get("done"):
                 chunk = self._completion_body(
                     rid, [], len(prompt),
-                    item.get("finish_reason", "length"))
+                    item.get("finish_reason", "length"),
+                    item.get("cached_tokens", 0))
                 chunk["object"] = "text_completion.chunk"
                 # the terminal chunk is where OpenAI clients read usage:
                 # report the real completion count, not the empty delta
@@ -216,7 +223,8 @@ class LLMServer:
         return self.tokenizer.encode(self.chat_template(messages))
 
     def _chat_body(self, rid: str, content: str, n_prompt: int,
-                   n_out: int, finish_reason) -> Dict[str, Any]:
+                   n_out: int, finish_reason,
+                   cached: int = 0) -> Dict[str, Any]:
         return {
             "id": f"chatcmpl-{rid}",
             "object": "chat.completion",
@@ -228,7 +236,8 @@ class LLMServer:
                          "finish_reason": finish_reason}],
             "usage": {"prompt_tokens": n_prompt,
                       "completion_tokens": n_out,
-                      "total_tokens": n_prompt + n_out},
+                      "total_tokens": n_prompt + n_out,
+                      "prompt_tokens_details": {"cached_tokens": cached}},
         }
 
     def chat_completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
@@ -242,7 +251,8 @@ class LLMServer:
         toks = out["token_ids"]
         return self._chat_body(
             out["request_id"], self.tokenizer.decode(toks), len(prompt),
-            len(toks), self.engine.finish_reason(out["request_id"]))
+            len(toks), self.engine.finish_reason(out["request_id"]),
+            self.engine.cached_tokens(out["request_id"]))
 
     def chat_completions_stream(self, request: Dict[str, Any]
                                 ) -> Iterator[Dict[str, Any]]:
@@ -258,7 +268,8 @@ class LLMServer:
             if item.get("done"):
                 chunk = self._chat_body(
                     rid, "", len(prompt), len(item.get("token_ids", ())),
-                    item.get("finish_reason", "length"))
+                    item.get("finish_reason", "length"),
+                    item.get("cached_tokens", 0))
                 chunk["object"] = "chat.completion.chunk"
                 chunk["choices"][0]["delta"] = {}
                 del chunk["choices"][0]["message"]
@@ -277,7 +288,17 @@ class LLMServer:
             yield chunk
 
     def stats(self) -> Dict[str, Any]:
-        return dict(self.engine.stats)
+        out = dict(self.engine.stats)
+        prefix = self.engine.prefix
+        if prefix is not None:
+            out["prefix_cache"] = {
+                "lookups": prefix.lookups, "hits": prefix.hits,
+                "hit_tokens": prefix.hit_tokens,
+                "evictions": prefix.evictions,
+                "cached_pages": prefix.num_cached,
+                "evictable_pages": prefix.num_evictable,
+            }
+        return out
 
     def check_health(self) -> None:
         if not self._thread.is_alive():

@@ -181,6 +181,28 @@ class TPEngineFns:
 
         self.write_prefill_pages_group = write_pages_group
 
+        def chunk_tok(params, tokens, pages, prior_len, valid_len,
+                      k_cache, v_cache):
+            # per-shard: local kv-heads write their chunk KV and attend
+            # over the local head slice of the page pool; the two psums
+            # per layer inside _prefill_chunk_body close the TP seam
+            return M._prefill_chunk_body(params, tokens, pages, prior_len,
+                                         valid_len, k_cache, v_cache, cfg,
+                                         TP_AXIS)
+
+        self.prefill_chunk_tok = jax.jit(shard_map_compat(
+            chunk_tok, mesh=mesh,
+            in_specs=(pspecs, P(None, None), P(None), rep, rep,
+                      CACHE_SPEC, CACHE_SPEC),
+            out_specs=(rep, CACHE_SPEC, CACHE_SPEC)),
+            donate_argnums=(5, 6))
+
+        self.copy_page = jax.jit(shard_map_compat(
+            M._copy_page_body, mesh=mesh,
+            in_specs=(CACHE_SPEC, CACHE_SPEC, rep, rep),
+            out_specs=(CACHE_SPEC, CACHE_SPEC)),
+            donate_argnums=(0, 1))
+
         # the kernel/reference choice follows the MESH platform, not the
         # process default backend — a CPU test mesh inside a TPU-default
         # worker must take the gather reference, and vice versa

@@ -233,6 +233,80 @@ def write_decode_kv(k_pages, v_pages, k_new, v_new, page_table,
     return k_pages, v_pages
 
 
+def write_chunk_kv(k_pages, v_pages, k_c, v_c, pages, start, valid_len,
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Scatter one prefill CHUNK's K/V — all layers at once — into one
+    sequence's pages.
+
+    k_c/v_c: [n_layers, C, Hkv, D] (C may be padded past the real
+    chunk); k/v_pages: [n_layers, P, Hkv, ps, D]; pages: [max_pages]
+    page ids (scratch-padded); start: absolute position of the chunk's
+    first token (cached prefix + earlier chunks already occupy positions
+    < start). Rows >= valid_len redirect to page 0 (the scratch page —
+    garbage by contract), so padding never corrupts live pages.
+
+    ONE scatter per chunk dispatch by design: threading the pool through
+    the per-layer scan (the obvious structure) stacks it as scan
+    carries/ys and degenerates into full-pool copies per layer — the
+    chunk program went pool-size-proportional, ~7x slower than a whole
+    128-token prefill on a 1024-page pool. Same discipline as
+    write_prefill_kv/stage_prefill_kv.
+    """
+    ps = k_pages.shape[3]
+    C = k_c.shape[1]
+    idx = jnp.arange(C)
+    pos = start + idx
+    real = idx < valid_len
+    page_idx = jnp.clip(pos // ps, 0, pages.shape[0] - 1)
+    page_ids = jnp.where(real, pages[page_idx], 0)
+    slots = jnp.where(real, pos % ps, 0)
+    # advanced indices (page_ids, slots) at axes 1 and 3 are separated by
+    # basic slices, so the indexed result is [C, n_layers, Hkv, D]
+    k_pages = k_pages.at[:, page_ids, :, slots, :].set(
+        k_c.transpose(1, 0, 2, 3).astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page_ids, :, slots, :].set(
+        v_c.transpose(1, 0, 2, 3).astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+def paged_chunk_attention(q, k_prior, v_prior, k_c, v_c, prior_len, *,
+                          sm_scale: Optional[float] = None) -> jax.Array:
+    """Prefill-chunk attention: cached prefix + the chunk's own K/V.
+
+    q: [C, Hq, D] chunk queries at absolute positions
+    prior_len + arange(C); k/v_prior: [n, Hkv, ps, D] ONE layer's pages
+    for this sequence, already gathered from the pool (positions
+    >= prior_len in them are stale — masked here, overwritten by
+    write_chunk_kv after the layer scan); k_c/v_c: [C, Hkv, D] the
+    chunk's roped K/V computed this call. Query i sees prior positions
+    t < prior_len plus chunk positions j <= i, so the chunk never has to
+    round-trip through the pool before attending. Gather-based: the
+    chunk path is dispatch-bound, not FLOP-bound, at serving chunk
+    sizes, and runs on every backend (the Pallas decode kernel is
+    single-query).
+    """
+    C, Hq, D = q.shape
+    n, Hkv, ps, _ = k_prior.shape
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    T = n * ps
+    k = jnp.concatenate(
+        [k_prior.transpose(1, 0, 2, 3).reshape(Hkv, T, D),
+         k_c.transpose(1, 0, 2)], axis=1)                  # [Hkv, T+C, D]
+    v = jnp.concatenate(
+        [v_prior.transpose(1, 0, 2, 3).reshape(Hkv, T, D),
+         v_c.transpose(1, 0, 2)], axis=1)
+    qg = q.reshape(C, Hkv, Hq // Hkv, D).astype(jnp.float32)
+    s = jnp.einsum("cgqd,gtd->cgqt", qg, k.astype(jnp.float32)) * sm_scale
+    i = jnp.arange(C)[:, None, None, None]
+    t = jnp.arange(T + C)[None, None, None, :]
+    visible = jnp.where(t < T, t < prior_len, (t - T) <= i)
+    s = jnp.where(visible, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("cgqt,gtd->cgqd", p, v.astype(jnp.float32))
+    return o.reshape(C, Hq, D).astype(q.dtype)
+
+
 def write_prefill_kv(k_pages, v_pages, k_seq, v_seq, pages,
                      ) -> Tuple[jax.Array, jax.Array]:
     """Write a whole prompt's K/V into its pages.

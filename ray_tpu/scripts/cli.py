@@ -211,6 +211,23 @@ def _render_top(client, address: str) -> str:
     qd = metrics.get("queue_depth", {}).get("values", {})
     queue_depth = sum(qd.values()) if qd else 0
     inflight = metrics.get("serve_inflight_requests", {}).get("values", {})
+
+    def _gauge(name):
+        vals = metrics.get(name, {}).get("values", {})
+        return sum(vals.values()) if vals else None
+
+    # LLM engine gauges (present when an InferenceEngine runs anywhere
+    # on the cluster): one summary line mirroring what vLLM logs per step
+    llm_decode = _gauge("llm_decode_tokens_per_s")
+    llm_line = ""
+    if llm_decode is not None:
+        kv = _gauge("llm_kv_page_utilization") or 0.0
+        hit = _gauge("llm_prefix_cache_hit_rate") or 0.0
+        pf = _gauge("llm_prefill_tokens_per_s") or 0.0
+        lq = _gauge("llm_queue_depth") or 0
+        llm_line = (f"llm: decode {llm_decode:.0f} tok/s  "
+                    f"prefill {pf:.0f} tok/s  kv_util {kv:.0%}  "
+                    f"prefix_hit {hit:.0%}  queued {lq:g}")
     nodes = dump["nodes"]
     alive = [n for n in nodes if n["alive"]]
     lines = [
@@ -219,6 +236,7 @@ def _render_top(client, address: str) -> str:
         f"queue_depth {queue_depth:g}"
         + (f"  serve_inflight {sum(inflight.values()):g}" if inflight
            else ""),
+    ] + ([llm_line] if llm_line else []) + [
         "",
         f"{'NODE':<14}{'ALIVE':<7}{'CPU%':>6}  {'MEM':>19}  "
         f"{'STORE':>19}  {'OBJS':>6}  {'HBM':>19}",

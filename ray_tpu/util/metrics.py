@@ -231,6 +231,43 @@ def train_mfu_gauge() -> Gauge:
                  description="model FLOPs utilization (0..1, rank 0)")
 
 
+def llm_kv_page_utilization_gauge() -> Gauge:
+    """Fraction of the paged KV pool's allocatable pages (all but the
+    scratch page) currently held by sequences or the prefix cache."""
+    return Gauge("llm_kv_page_utilization",
+                 description="KV cache page utilization (0..1)")
+
+
+def llm_prefix_hit_rate_gauge() -> Gauge:
+    """Cumulative fraction of prompt tokens served from cached prefix
+    pages instead of being prefilled (vLLM's prefix-cache hit rate, by
+    tokens not lookups — the number that predicts TTFT savings)."""
+    return Gauge("llm_prefix_cache_hit_rate",
+                 description="prompt tokens served from the prefix "
+                             "cache / total prompt tokens (0..1)")
+
+
+def llm_prefill_tokens_per_s_gauge() -> Gauge:
+    """Prompt tokens prefilled per second (fast-path groups + chunked
+    tails), over the engine's ~1s gauge window."""
+    return Gauge("llm_prefill_tokens_per_s",
+                 description="prompt tokens prefilled per second")
+
+
+def llm_decode_tokens_per_s_gauge() -> Gauge:
+    """Tokens decoded per second across the running batch, over the
+    engine's ~1s gauge window."""
+    return Gauge("llm_decode_tokens_per_s",
+                 description="tokens decoded per second (whole batch)")
+
+
+def llm_queue_depth_gauge() -> Gauge:
+    """Requests waiting for admission into the engine (not yet holding
+    a slot) — the backpressure signal for serve autoscaling."""
+    return Gauge("llm_queue_depth",
+                 description="LLM requests waiting for admission")
+
+
 def tune_running_trials_gauge() -> Gauge:
     """Trials currently holding an actor in this tuner process."""
     return Gauge("tune_running_trials",

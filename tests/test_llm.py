@@ -143,6 +143,55 @@ def test_page_allocator():
     assert a.num_free == 7
 
 
+def test_page_allocator_refcounts_and_double_free():
+    from ray_tpu.llm.cache import DoubleFreeError
+    a = PageAllocator(8)            # strict under pytest
+    (p,) = a.alloc(1)
+    assert a.refcount(p) == 1
+    a.incref([p])
+    assert a.refcount(p) == 2
+    a.free([p])                     # decref only: still allocated
+    assert a.refcount(p) == 1 and a.num_free == 6
+    a.free([p])                     # last ref: back on the free list
+    assert a.refcount(p) == 0 and a.num_free == 7
+    with pytest.raises(DoubleFreeError):
+        a.free([p])
+    with pytest.raises(ValueError):
+        a.incref([p])               # unallocated page can't gain sharers
+    relaxed = PageAllocator(8, strict_free=False)
+    (q,) = relaxed.alloc(1)
+    relaxed.free([q])
+    relaxed.free([q])               # production mode: logged and skipped
+    assert relaxed.num_free == 7
+
+
+def test_prefix_cache_match_register_evict():
+    from ray_tpu.llm.cache import PrefixCache
+    a = PageAllocator(16)
+    c = PrefixCache(a, page_size=4)
+    prompt = list(range(10))        # 2 full blocks + 2-token tail
+    pages = a.alloc(3)
+    c.register(prompt, pages)       # publishes the 2 full blocks only
+    assert c.num_cached == 2
+    hit, matched, cow = c.match(prompt)
+    assert hit == pages[:2] and matched == 8 and not cow
+    # exact page multiple: cap at len-1 cuts into the last shared page
+    hit2, matched2, cow2 = c.match(prompt[:8])
+    assert matched2 == 7 and cow2
+    # different second block: partial (single-block) match
+    hit3, matched3, _ = c.match(prompt[:4] + [99, 98, 97, 96])
+    assert hit3 == pages[:1] and matched3 == 4
+    for h in (hit, hit2, hit3):
+        a.free(h)
+        c.note_release(h)
+    assert c.num_evictable == 0     # original refs still held
+    a.free(pages)
+    c.note_release(pages)
+    assert c.num_evictable == 2     # only the cache references them now
+    assert c.evict(5) == 2 and c.num_cached == 0
+    assert a.num_free == 15
+
+
 def test_batched_prefill_group_matches_oracle(params):
     """Same-bucket prompts admit as ONE batched prefill dispatch and
     still reproduce each prompt's solo greedy output exactly."""
@@ -163,6 +212,167 @@ def test_batched_prefill_group_matches_oracle(params):
         results.update(eng.step())
     for rid, want in zip(rids, solo):
         assert results[rid] == want, f"{rid}: {results[rid]} vs {want}"
+
+
+# ------------------------------------- chunked prefill + prefix caching
+
+
+def test_chunked_prefill_matches_oracle(params):
+    """Chunk-by-chunk prefill (chunk attention over prior paged KV) must
+    reproduce the one-shot prefill greedy stream exactly."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128,
+                          prefix_cache=False, prefill_chunk=8)
+    prompt = [(5 * i + 2) % CFG.vocab_size for i in range(20)]
+    got = eng.generate(prompt, max_new_tokens=8)
+    assert eng.stats["chunk_dispatches"] == 3   # 8 + 8 + 4 tokens
+    assert got == _oracle_greedy(params, prompt, 8)
+
+
+def test_step_token_budget_slices_chunks(params):
+    """A per-step budget below prefill_chunk bounds each step's chunk;
+    the output is budget-invariant."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128,
+                          prefix_cache=False, prefill_chunk=8,
+                          step_token_budget=4)
+    prompt = [(5 * i + 2) % CFG.vocab_size for i in range(20)]
+    got = eng.generate(prompt, max_new_tokens=8)
+    assert eng.stats["chunk_dispatches"] == 5   # 4-token slices
+    assert got == _oracle_greedy(params, prompt, 8)
+
+
+def test_prefix_cache_hit_and_cached_tokens(params):
+    """A repeated prompt reuses its full KV pages: only the tail
+    prefills, the output is unchanged, and cached tokens are reported."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128)
+    prompt = [(7 * i + 3) % CFG.vocab_size for i in range(20)]
+    want = _oracle_greedy(params, prompt, 8)
+    assert eng.generate(prompt, max_new_tokens=8) == want   # cold
+    pf0 = eng.stats["prefill_tokens"]
+    rid = eng.add_request(prompt, 8)
+    done = {}
+    for _ in range(100):
+        done.update(eng.step())
+        if rid in done:
+            break
+    assert done[rid] == want
+    assert eng.stats["cached_tokens"] == 16     # 2 full pages reused
+    assert eng.cached_tokens(rid) == 16
+    assert eng.cached_tokens(rid) == 0          # accounting pops
+    assert eng.stats["prefill_tokens"] - pf0 == 4   # only the tail
+
+
+def test_prefix_cache_partial_hit(params):
+    """Prompts sharing only the first page reuse exactly that page."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128)
+    a = [(3 * i + 2) % CFG.vocab_size for i in range(20)]
+    b = a[:8] + [(11 * i + 5) % CFG.vocab_size for i in range(12)]
+    assert eng.generate(a, 6) == _oracle_greedy(params, a, 6)
+    want = _oracle_greedy(params, b, 6)
+    rid = eng.add_request(b, 6)
+    done = {}
+    for _ in range(100):
+        done.update(eng.step())
+        if rid in done:
+            break
+    assert done[rid] == want
+    assert eng.cached_tokens(rid) == 8
+
+
+def test_prefix_cache_cow_on_exact_page_multiple(params):
+    """Prompt length an exact page multiple with every block cached: the
+    match caps at len-1, which lands the tail INSIDE the last shared
+    page — the engine must copy it (COW) and still match the oracle."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=64,
+                          max_batch=4, max_seq_len=128)
+    prompt = [(9 * i + 4) % CFG.vocab_size for i in range(16)]
+    want = _oracle_greedy(params, prompt, 6)
+    assert eng.generate(prompt, max_new_tokens=6) == want
+    rid = eng.add_request(prompt, 6)
+    done = {}
+    for _ in range(100):
+        done.update(eng.step())
+        if rid in done:
+            break
+    assert done[rid] == want
+    assert eng.stats["cow_copies"] == 1
+    assert eng.cached_tokens(rid) == 15
+
+
+def test_prefix_cache_evicts_under_pressure(params):
+    """Cached pages are free HBM: when a new prompt can't allocate, LRU
+    cached pages return to the free list and admission succeeds."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=8,
+                          max_batch=2, max_seq_len=64)
+    small = [(2 * i + 1) % CFG.vocab_size for i in range(16)]
+    assert eng.generate(small, 4) == _oracle_greedy(params, small, 4)
+    assert eng.prefix.num_evictable == 2        # its 2 full pages cached
+    big = [(13 * i + 7) % CFG.vocab_size for i in range(40)]
+    assert eng.generate(big, 4) == _oracle_greedy(params, big, 4)
+    assert eng.prefix.evictions >= 1
+
+
+def test_decode_interleaves_with_chunked_prefill(params):
+    """A long prompt chunk-prefills WHILE the running batch keeps
+    decoding — the decode stream is never stalled for the whole prefill
+    (the head-of-line fix this PR is for)."""
+    eng = InferenceEngine(CFG, params, page_size=8, total_pages=128,
+                          max_batch=4, max_seq_len=256, decode_chunk=4,
+                          prefix_cache=False, prefill_chunk=8,
+                          step_token_budget=8)
+    a = [9, 4, 33, 2, 71]
+    b = [(5 * i + 1) % CFG.vocab_size for i in range(40)]
+    wa = _oracle_greedy(params, a, 28)    # 7 decode dispatches of 4:
+    wb = _oracle_greedy(params, b, 4)     # outlives b's 5 chunk steps
+    results = {}
+    ra = eng.add_request(a, 28)
+    results.update(eng.step())          # a joins the decode batch
+    d0 = eng.stats["decode_tokens"]
+    rb = eng.add_request(b, 4)
+    for _ in range(20):
+        results.update(eng.step())
+        if not any(s.request_id == rb for s in eng._chunking):
+            break
+    assert eng.stats["chunk_dispatches"] == 5       # 40 tokens / 8
+    assert eng.stats["decode_tokens"] > d0, \
+        "decode starved while the long prompt prefilled"
+    for _ in range(100):
+        if ra in results and rb in results:
+            break
+        results.update(eng.step())
+    assert results[ra] == wa and results[rb] == wb
+
+
+def test_admission_lookahead_avoids_head_of_line(params):
+    """A head request that can't get pages must not block an admissible
+    request behind it (bounded lookahead) — unless the head has aged
+    past admit_age_cap_s, in which case freed pages are reserved for it."""
+    def setup(**kw):
+        eng = InferenceEngine(CFG, params, page_size=8, total_pages=8,
+                              max_batch=3, max_seq_len=64,
+                              prefix_cache=False, **kw)
+        # decoder holding 5 of the 7 allocatable pages
+        eng.add_request([(2 * i + 1) % CFG.vocab_size
+                         for i in range(24)], 30)
+        eng.step()
+        rb = eng.add_request([(3 * i + 2) % CFG.vocab_size
+                              for i in range(17)], 4)   # needs 3 pages
+        rc = eng.add_request([11, 5, 42, 7, 9, 1, 3], 4)  # needs 1 page
+        eng.step()
+        waiting = {s.request_id for s in eng.waiting}
+        return rb, rc, waiting
+
+    rb, rc, waiting = setup()
+    assert rb in waiting, "head shouldn't fit yet"
+    assert rc not in waiting, "lookahead should admit the small prompt"
+
+    # aged head (cap 0 -> instantly aged): scan freezes at the head
+    rb, rc, waiting = setup(admit_age_cap_s=0.0)
+    assert rb in waiting and rc in waiting, \
+        "aged memory-blocked head must stop younger requests jumping it"
 
 
 # ------------------------------------------------------------------- tp
@@ -194,6 +404,31 @@ def test_tp_engine_matches_single_chip(params):
     for a, b in zip(r1, r2):
         assert d1[a] == d2[b], (d1[a], d2[b])
     assert e2.stats["prefill_dispatches"] == e1.stats["prefill_dispatches"]
+
+
+def test_tp_chunked_prefill_prefix_and_cow(params):
+    """The sharded chunk-prefill and COW page-copy programs (shard_map
+    over kv-head shards) reproduce the oracle stream: chunked cold
+    prefill, a prefix-cache hit, and an exact-page-multiple COW."""
+    eng = InferenceEngine(CFG, params, tp=2, page_size=8, total_pages=64,
+                          max_batch=2, max_seq_len=128, decode_chunk=4,
+                          prefill_chunk=8)
+    prompt = [(5 * i + 2) % CFG.vocab_size for i in range(20)]
+    want = _oracle_greedy(params, prompt, 6)
+    assert eng.generate(prompt, max_new_tokens=6) == want   # chunked cold
+    assert eng.stats["chunk_dispatches"] == 3
+    rid = eng.add_request(prompt, 6)                        # prefix hit
+    done = {}
+    for _ in range(100):
+        done.update(eng.step())
+        if rid in done:
+            break
+    assert done[rid] == want
+    assert eng.cached_tokens(rid) == 16
+    p2 = prompt[:16]                      # exact page multiple: COW path
+    assert eng.generate(p2, max_new_tokens=4) == \
+        _oracle_greedy(params, p2, 4)
+    assert eng.stats["cow_copies"] == 1
 
 
 def test_tp_validation():

@@ -35,7 +35,11 @@ def test_batch_inference_text_prompts(rt):
 
 def test_batch_inference_is_deterministic_per_prompt(rt):
     """The same prompt through the pool gives the same greedy tokens
-    regardless of which rows share its block (engine invariance)."""
+    regardless of which rows share its block (engine invariance). With
+    prefix caching on by default this also pins hit-vs-cold parity: the
+    first "repeat me" in each engine prefills cold, the rest reuse its
+    cached full page and chunk-prefill only the tail — the greedy
+    stream must be identical either way."""
     rows = [{"prompt": "repeat me"} for _ in range(6)]
     out = batch_inference(
         rd.from_items(rows, num_blocks=3),
