@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel.attention import causal_attention
@@ -49,8 +50,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32   # master weights
     remat: bool = True
-    remat_policy: str = "full"       # full | dots | dots_no_batch
+    remat_policy: str = "full"       # full | dots | dots_no_batch | selective
     pp_microbatches: int = 4         # microbatch count when pp > 1
+    fsdp_overlap: bool = False       # explicit prefetch-scheduled fsdp step
+    int8_mlp: bool = False           # dynamic-W8A8 MLP matmuls (ops.int8)
 
     @property
     def head_dim(self) -> int:
@@ -150,29 +153,66 @@ def _full_attention(q, k, v):
     return causal_attention(q, k, v).astype(q.dtype)
 
 
+#: checkpoint_name tags on the 7 projection-matmul outputs per layer —
+#: what remat_policy="selective" saves (and nothing else)
+SELECTIVE_SAVE_NAMES = ("attn_q", "attn_k", "attn_v", "attn_o",
+                        "mlp_gate", "mlp_up", "mlp_down",
+                        "moe_out")  # mixtral's combined expert output
+
+
+def remat_policy_fn(name: str):
+    """Config string → jax.checkpoint policy (shared with mixtral).
+
+    "dots" saves EVERY dot output — including the [B, H, L, L] attention
+    scores, whose save cost scales L²; "selective" saves only the 7 named
+    projection outputs per layer (all [B, L, ·]), recomputing norms/rope/
+    attention — the TorchTitan-style middle ground between full remat
+    (max recompute) and dots (max residual memory)."""
+    if name == "full":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if name == "selective":
+        return jax.checkpoint_policies.save_only_these_names(
+            *SELECTIVE_SAVE_NAMES)
+    raise ValueError(f"unknown remat_policy {name!r}")
+
+
 def _layer(lp: Params, x, cfg: LlamaConfig, positions, attn_fn):
     """One transformer block; lp leaves have the layer axis removed."""
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     B, L, _ = x.shape
     cd = cfg.dtype
 
+    if cfg.int8_mlp:
+        from ray_tpu.ops.int8 import int8_matmul
+
+        def mlp_mm(a, w):
+            return int8_matmul(a, w.astype(cd))
+    else:
+        def mlp_mm(a, w):
+            return a @ w.astype(cd)
+
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(cd)).reshape(B, L, hq, hd)
-    k = (h @ lp["wk"].astype(cd)).reshape(B, L, hkv, hd)
-    v = (h @ lp["wv"].astype(cd)).reshape(B, L, hkv, hd)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = checkpoint_name(h @ lp["wq"].astype(cd), "attn_q")
+    k = checkpoint_name(h @ lp["wk"].astype(cd), "attn_k")
+    v = checkpoint_name(h @ lp["wv"].astype(cd), "attn_v")
+    q = _rope(q.reshape(B, L, hq, hd), positions, cfg.rope_theta)
+    k = _rope(k.reshape(B, L, hkv, hd), positions, cfg.rope_theta)
+    v = v.reshape(B, L, hkv, hd)
     if hkv != hq:  # GQA: repeat KV groups to full head count
         rep = hq // hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     o = attn_fn(q, k, v).reshape(B, L, hq * hd)
-    x = x + (o @ lp["wo"].astype(cd))
+    x = x + checkpoint_name(o @ lp["wo"].astype(cd), "attn_o")
 
     h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(cd))
-    up = h @ lp["w_up"].astype(cd)
-    x = x + ((gate * up) @ lp["w_down"].astype(cd))
+    gate = jax.nn.silu(checkpoint_name(mlp_mm(h, lp["w_gate"]), "mlp_gate"))
+    up = checkpoint_name(mlp_mm(h, lp["w_up"]), "mlp_up")
+    x = x + checkpoint_name(mlp_mm(gate * up, lp["w_down"]), "mlp_down")
     return x
 
 
@@ -180,16 +220,7 @@ def _scan_layers(layers: Params, x, cfg: LlamaConfig, positions, attn_fn):
     body = functools.partial(_layer, cfg=cfg, positions=positions,
                              attn_fn=attn_fn)
     if cfg.remat:
-        # "dots" keeps matmul outputs and recomputes only cheap elementwise
-        # ops in backward — much less recompute FLOP than full remat at a
-        # modest memory cost (HBM-bandwidth-friendly default on TPU).
-        policy = {
-            "full": None,
-            "dots": jax.checkpoint_policies.checkpoint_dots,
-            "dots_no_batch":
-                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-        }[cfg.remat_policy]
-        body = jax.checkpoint(body, policy=policy)
+        body = jax.checkpoint(body, policy=remat_policy_fn(cfg.remat_policy))
 
     def step(x, lp):
         return body(lp, x), None
@@ -239,7 +270,9 @@ def _make_attn_fn(cfg: LlamaConfig, mesh):
             return lambda q, k, v: blockwise_attention(q, k, v).astype(q.dtype)
         if mesh is not None:
             return functools.partial(flash_attention_sharded, mesh=mesh)
-        return flash_attention
+        # blk=None: use the autotuned block for this shape when one is
+        # cached (bench warms the cache eagerly), else the classic 256
+        return functools.partial(flash_attention, blk_q=None, blk_k=None)
     if mesh is None:
         raise ValueError(f"attention={cfg.attention!r} needs a mesh")
     if cfg.attention == "ring":
@@ -305,18 +338,82 @@ def _forward_pipelined(params: Params, x, cfg: LlamaConfig, mesh, positions):
     return out.reshape(B, L, D)
 
 
+def _nll_mean(logits, tokens):
+    """Shifted next-token NLL mean; logits [B, L, V] fp32, tokens [B, L]."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def _loss_overlap(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                  mesh) -> jax.Array:
+    """fsdp_overlap=True loss: full-manual shard_map over (dp, fsdp) with
+    the prefetch-scheduled layer scan (parallel.fsdp_overlap) instead of
+    GSPMD-placed gathers. Numerics match loss_fn exactly (parity-tested);
+    only the collective schedule differs. Requires pp == sp == tp == 1 —
+    jax 0.4.x shard_map_compat degrades partial-manual to full manual,
+    so every other parallelism axis must be trivial here.
+    """
+    from ray_tpu.parallel.fsdp_overlap import (drop_leading_dim,
+                                               gather_params, overlap_scan,
+                                               project_specs)
+
+    for ax in ("pp", "sp", "tp"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                f"fsdp_overlap runs full-manual over (dp, fsdp); mesh axis "
+                f"{ax!r} has size {mesh.shape[ax]} > 1")
+    if cfg.attention not in ("full", "flash"):
+        raise ValueError(
+            f"fsdp_overlap supports attention in {{'full','flash'}}, got "
+            f"{cfg.attention!r}")
+    attn_fn = _make_attn_fn(cfg, None)  # per-shard, batch-only sharding
+    specs = project_specs(param_specs(cfg), ("fsdp",))
+    lspecs = drop_leading_dim(specs["layers"])
+    cd = cfg.dtype
+
+    def block(params, tokens):
+        L = tokens.shape[1]
+        positions = jnp.arange(L)
+        embed = gather_params(params["embed"], specs["embed"], "fsdp")
+        x = embed.astype(cd)[tokens]
+        body = functools.partial(_layer, cfg=cfg, positions=positions,
+                                 attn_fn=attn_fn)
+        if cfg.remat:
+            body = jax.checkpoint(body,
+                                  policy=remat_policy_fn(cfg.remat_policy))
+        x = overlap_scan(params["layers"], lspecs, x, body, cfg.n_layers,
+                         axis_name="fsdp")
+        x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("bld,vd->blv", x.astype(cd), embed.astype(cd),
+                            preferred_element_type=jnp.float32)
+        # equal-size batch shards → pmean of shard means == global mean
+        return lax.pmean(_nll_mean(logits, tokens), ("dp", "fsdp"))
+
+    fn = shard_map_compat(block, mesh=mesh,
+                          in_specs=(specs, P(("dp", "fsdp"), None)),
+                          out_specs=P())
+    return fn(params, tokens)
+
+
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
             mesh=None) -> jax.Array:
     """Next-token cross-entropy (mean over B×(L-1) positions), fp32.
 
     The FULL sequence goes through forward (keeps L divisible by the sp
     axis for ring/ulysses); the shift happens on logits afterwards.
+
+    cfg.fsdp_overlap routes to the explicit prefetch-scheduled manual
+    step (same numerics, overlap-friendly collective placement) whenever
+    the mesh actually shards fsdp.
     """
-    logits = forward(params, tokens, cfg, mesh)[:, :-1]
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    if cfg.fsdp_overlap and mesh is not None \
+            and mesh.shape.get("fsdp", 1) > 1:
+        return _loss_overlap(params, tokens, cfg, mesh)
+    logits = forward(params, tokens, cfg, mesh)
+    return _nll_mean(logits, tokens)
 
 
 def num_params(cfg: LlamaConfig) -> int:

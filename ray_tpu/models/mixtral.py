@@ -22,9 +22,12 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
-from ray_tpu.models.llama import _full_attention, _rmsnorm, _rope
+from ray_tpu.models.llama import (_full_attention, _nll_mean, _rmsnorm,
+                                  _rope, remat_policy_fn)
+from ray_tpu.parallel.mesh import shard_map_compat
 from ray_tpu.parallel.moe import _routing, moe_ffn, moe_ffn_sharded
 
 Params = Dict[str, Any]
@@ -47,6 +50,8 @@ class MixtralConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    remat_policy: str = "full"     # full | dots | dots_no_batch | selective
+    fsdp_overlap: bool = False     # explicit prefetch-scheduled fsdp step
 
     @property
     def head_dim(self) -> int:
@@ -135,17 +140,18 @@ def _layer(lp: Params, x, cfg: MixtralConfig, positions, mesh):
     cd = cfg.dtype
 
     h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(cd)).reshape(B, L, hq, hd)
-    k = (h @ lp["wk"].astype(cd)).reshape(B, L, hkv, hd)
-    v = (h @ lp["wv"].astype(cd)).reshape(B, L, hkv, hd)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = checkpoint_name(h @ lp["wq"].astype(cd), "attn_q")
+    k = checkpoint_name(h @ lp["wk"].astype(cd), "attn_k")
+    v = checkpoint_name(h @ lp["wv"].astype(cd), "attn_v")
+    q = _rope(q.reshape(B, L, hq, hd), positions, cfg.rope_theta)
+    k = _rope(k.reshape(B, L, hkv, hd), positions, cfg.rope_theta)
+    v = v.reshape(B, L, hkv, hd)
     if hkv != hq:
         rep = hq // hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     o = _full_attention(q, k, v).reshape(B, L, hq * hd)
-    x = x + (o @ lp["wo"].astype(cd))
+    x = x + checkpoint_name(o @ lp["wo"].astype(cd), "attn_o")
 
     h = _rmsnorm(x, lp["moe_norm"], cfg.norm_eps)
     flat = h.reshape(B * L, d)
@@ -161,7 +167,9 @@ def _layer(lp: Params, x, cfg: MixtralConfig, positions, mesh):
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     topk_idx, _ = _routing(moe_p, flat, cfg.top_k)
     aux = _aux_loss(probs, topk_idx, cfg.n_experts)
-    return x + y.reshape(B, L, d), aux
+    # "moe_out" rides SELECTIVE_SAVE_NAMES: selective remat saves the
+    # combined expert output and recomputes the dispatch in backward
+    return x + checkpoint_name(y, "moe_out").reshape(B, L, d), aux
 
 
 def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
@@ -175,7 +183,7 @@ def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
     body = functools.partial(_layer, cfg=cfg, positions=positions,
                              mesh=mesh)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=remat_policy_fn(cfg.remat_policy))
 
     def step(x, lp):
         x, aux = body(lp, x)
@@ -191,15 +199,60 @@ def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
     return logits
 
 
+def _loss_overlap(params: Params, tokens: jax.Array, cfg: MixtralConfig,
+                  mesh) -> jax.Array:
+    """fsdp_overlap=True loss: full-manual (dp, fsdp) shard_map with the
+    prefetch-scheduled layer scan (see llama._loss_overlap). Experts run
+    the dense moe_ffn path per shard, so ep must be 1 here."""
+    from ray_tpu.parallel.fsdp_overlap import (drop_leading_dim,
+                                               gather_params, overlap_scan,
+                                               project_specs)
+
+    for ax in ("pp", "sp", "tp", "ep"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                f"fsdp_overlap runs full-manual over (dp, fsdp); mesh axis "
+                f"{ax!r} has size {mesh.shape[ax]} > 1")
+    specs = project_specs(param_specs(cfg), ("fsdp",))
+    lspecs = drop_leading_dim(specs["layers"])
+    cd = cfg.dtype
+
+    def block(params, tokens):
+        L = tokens.shape[1]
+        positions = jnp.arange(L)
+        embed = gather_params(params["embed"], specs["embed"], "fsdp")
+        x = embed.astype(cd)[tokens]
+        body = functools.partial(_layer, cfg=cfg, positions=positions,
+                                 mesh=None)
+        if cfg.remat:
+            body = jax.checkpoint(body,
+                                  policy=remat_policy_fn(cfg.remat_policy))
+        x, aux = overlap_scan(params["layers"], lspecs, x, body,
+                              cfg.n_layers, axis_name="fsdp", has_aux=True)
+        x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("bld,vd->blv", x.astype(cd), embed.astype(cd),
+                            preferred_element_type=jnp.float32)
+        loss = _nll_mean(logits, tokens) + cfg.aux_loss_coef * jnp.mean(aux)
+        # equal-size batch shards → pmean of shard means == global mean
+        return lax.pmean(loss, ("dp", "fsdp"))
+
+    fn = shard_map_compat(block, mesh=mesh,
+                          in_specs=(specs, P(("dp", "fsdp"), None)),
+                          out_specs=P())
+    return fn(params, tokens)
+
+
 def loss_fn(params: Params, tokens: jax.Array, cfg: MixtralConfig,
             mesh=None) -> jax.Array:
-    """Next-token CE + aux load-balance term (Mixtral training objective)."""
+    """Next-token CE + aux load-balance term (Mixtral training objective).
+
+    cfg.fsdp_overlap routes to the explicit prefetch-scheduled manual
+    step whenever the mesh actually shards fsdp (same numerics)."""
+    if cfg.fsdp_overlap and mesh is not None \
+            and mesh.shape.get("fsdp", 1) > 1:
+        return _loss_overlap(params, tokens, cfg, mesh)
     logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
-    logits = logits[:, :-1]
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + cfg.aux_loss_coef * aux
+    return _nll_mean(logits, tokens) + cfg.aux_loss_coef * aux
 
 
 def num_params(cfg: MixtralConfig) -> int:

@@ -6,10 +6,14 @@ flash attention (training), with blockwise-JAX fallbacks that run anywhere
 (CPU mesh tests, interpret mode).
 """
 
-from ray_tpu.ops.flash_attention import (blockwise_attention,
+from ray_tpu.ops.flash_attention import (autotune_blocks,
+                                         blockwise_attention,
                                          flash_attention,
                                          flash_attention_sharded,
+                                         get_tuned_blocks,
                                          kernels_supported)
+from ray_tpu.ops.int8 import int8_matmul
 
 __all__ = ["flash_attention", "flash_attention_sharded",
-           "blockwise_attention", "kernels_supported"]
+           "blockwise_attention", "kernels_supported",
+           "autotune_blocks", "get_tuned_blocks", "int8_matmul"]

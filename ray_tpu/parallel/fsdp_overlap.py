@@ -1,0 +1,122 @@
+"""Explicit FSDP collective/compute overlap for scan-over-layers models.
+
+GSPMD places the fsdp param all-gathers wherever its scheduler likes —
+in practice the per-layer gather lands right before the layer that needs
+it and serializes against the MXU (the 48% MFU plateau, ROADMAP item 3).
+This module makes the schedule explicit instead, veScale-style eager
+SPMD: run the whole step full-manual under `shard_map_compat` and
+software-pipeline the gathers through the scan carry —
+
+  * forward: the layer-``i+1`` shard gather (`lax.all_gather`, tiled) is
+    issued BEFORE layer-``i``'s compute, so XLA's async collectives hide
+    it behind the matmuls (double buffering: exactly one prefetched
+    layer in flight);
+  * backward: autodiff transposes each tiled ``all_gather`` into a
+    ``psum_scatter`` — the grad reduce-scatters interleave with the
+    backward scan the same way, instead of bunching at the end.
+
+Memory note: the prefetched layer rides the scan carry, so residuals
+hold gathered (unsharded) per-layer params. `jax.checkpoint` around the
+layer body still recomputes activations; runs that need ZeRO-3 residual
+memory too should keep the GSPMD path.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def spec_axis_dim(spec, axis: str):
+    """Index of the array dim `spec` shards over mesh axis `axis`, or None."""
+    if spec is None:
+        return None
+    for i, entry in enumerate(spec):
+        if entry == axis or (isinstance(entry, (tuple, list))
+                             and axis in entry):
+            return i
+    return None
+
+
+def project_specs(specs, keep_axes) -> Any:
+    """Drop every mesh-axis name not in `keep_axes` from a PartitionSpec
+    pytree (the dropped dims become replicated). Used to re-shard params
+    for the full-manual overlap step, where only dp/fsdp are real."""
+    keep = set(keep_axes)
+
+    def proj(spec):
+        if spec is None:
+            return P()
+        out = []
+        for entry in spec:
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in keep)
+                out.append(kept if len(kept) > 1
+                           else (kept[0] if kept else None))
+            else:
+                out.append(entry if entry in keep else None)
+        return P(*out)
+
+    return jax.tree.map(proj, specs)
+
+
+def drop_leading_dim(specs) -> Any:
+    """Specs for leaves after `lax.dynamic_index_in_dim(..., axis=0)` —
+    the stacked-layer dim disappears."""
+    return jax.tree.map(lambda s: P(*tuple(s)[1:]), specs)
+
+
+def gather_params(tree, specs, axis_name: str):
+    """All-gather every leaf along its `axis_name`-sharded dim (tiled, so
+    the transpose is psum_scatter); leaves not sharded on `axis_name`
+    pass through. Call inside manual (shard_map) code only."""
+
+    def g(x, spec):
+        d = spec_axis_dim(spec, axis_name)
+        if d is None:
+            return x
+        return lax.all_gather(x, axis_name, axis=d, tiled=True)
+
+    return jax.tree.map(g, tree, specs)
+
+
+def overlap_scan(layers, layer_specs, x, apply_fn, n_layers: int,
+                 axis_name: str = "fsdp", has_aux: bool = False):
+    """Scan `apply_fn` over stacked layers with double-buffered param
+    prefetch: the gather of layer ``i+1``'s shards is issued before layer
+    ``i``'s compute so the collective overlaps the matmuls.
+
+    layers: pytree of [n_layers, ...] leaves, each sharded per
+    `layer_specs` (specs of the PER-LAYER slice, layer dim removed) on
+    `axis_name`. apply_fn(gathered_layer_params, x) -> x (or (x, aux)
+    when has_aux). Must run inside manual code where `axis_name` is a
+    manual shard_map axis.
+    """
+
+    def gather_layer(i):
+        sliced = jax.tree.map(
+            lambda w: lax.dynamic_index_in_dim(w, i, axis=0, keepdims=False),
+            layers)
+        return gather_params(sliced, layer_specs, axis_name)
+
+    w0 = gather_layer(0)
+
+    def step(carry, i):
+        x, w = carry
+        # prefetch FIRST: the i+1 gather has no data dependence on this
+        # layer's compute, so the scheduler can run them concurrently
+        # (the last iteration re-gathers layer n-1 — shape-static no-op
+        # overlap slot, its result is discarded)
+        w_next = gather_layer(jnp.minimum(i + 1, n_layers - 1))
+        out = apply_fn(w, x)
+        if has_aux:
+            x, aux = out
+            return (x, w_next), aux
+        return (out, w_next), None
+
+    (x, _), aux = lax.scan(step, (x, w0), jnp.arange(n_layers))
+    return (x, aux) if has_aux else x

@@ -75,7 +75,28 @@ def _merge_blocks(o1, lse1, o2, lse2):
     return o1 * wt1 + o2 * wt2, m + jnp.log(denom)
 
 
-def _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret):
+def _resolve_fused_blocks(Lq: int, Lk: int, head_dim: int, dtype,
+                          interpret: bool):
+    """(blk_q, blk_k) for the fused ring path, or None when the shard
+    lengths cannot meet the Mosaic >= 8 sublane floor. Tuned entries
+    (ops.flash_attention.autotune_blocks, shared cache) win; otherwise
+    the divisor heuristic. Only interpret mode — where no Mosaic tiling
+    exists — may go below the floor (tiny CPU test shards)."""
+    from ray_tpu.ops.flash_attention import get_tuned_blocks, pick_block
+
+    tuned = get_tuned_blocks(Lq, Lk, head_dim, dtype)
+    if tuned is not None:
+        return tuned
+    floor = 1 if interpret else 8
+    blk_q = pick_block(Lq, min_block=floor)
+    blk_k = pick_block(Lk, min_block=floor)
+    if blk_q is None or blk_k is None:
+        return None
+    return blk_q, blk_k
+
+
+def _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret,
+                blk_q, blk_k):
     """Ring loop whose per-rotation compute is the Pallas flash block
     kernel (ops/flash_attention.py): KV streams through VMEM fused with
     the online softmax on the MXU while lax.ppermute rotates the next
@@ -83,15 +104,11 @@ def _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret):
     (normalized o + lse) combine by log-sum-exp; lse stays differentiable
     through the merge (its cotangent folds into the backward kernels'
     delta term)."""
-    from ray_tpu.ops.flash_attention import flash_attention_block, pick_block
+    from ray_tpu.ops.flash_attention import flash_attention_block
 
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
-    # explicit use_kernel=True (incl. interpret-mode tests) may run sub-8
-    # blocks; AUTO selection filtered on the >= 8 floor already
-    blk_q = pick_block(Lq, min_block=1)
-    blk_k = pick_block(k.shape[1], min_block=1)
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     lse0 = jnp.full((B, H, Lq), _NEG_INF, jnp.float32)
@@ -150,16 +167,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    blocks = _resolve_fused_blocks(q.shape[1], k.shape[1], q.shape[-1],
+                                   q.dtype, interpret)
     if use_kernel is None:
-        from ray_tpu.ops.flash_attention import (kernels_supported,
-                                                 pick_block)
+        from ray_tpu.ops.flash_attention import kernels_supported
         # auto: fused only where the Mosaic kernels lower AND the shard
-        # lengths divide into kernel blocks; else the einsum path below
-        supported = kernels_supported()
-        use_kernel = (supported
-                      and pick_block(q.shape[1]) is not None
-                      and pick_block(k.shape[1]) is not None)
-        if supported and not use_kernel:
+        # lengths divide into valid (>= 8 sublane floor) kernel blocks;
+        # else the einsum path below
+        use_kernel = kernels_supported() and blocks is not None
+    elif use_kernel and blocks is None:
+        # Explicit use_kernel=True but no block meets the Mosaic >= 8
+        # sublane floor (compiled kernels below it miscompile): degrade
+        # to the einsum ring — identical numerics, never a bad program.
+        use_kernel = False
+    if not use_kernel and blocks is None:
+        from ray_tpu.ops.flash_attention import kernels_supported
+        if kernels_supported():
             # the hardware would run the fused kernel but these shard
             # lengths don't divide into kernel blocks: surface the
             # silent degradation (VERDICT r4 weak #5) — strict mode
@@ -175,7 +198,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             warnings.warn(msg, RingAttentionFallbackWarning, stacklevel=2)
     _LAST_PATH["path"] = "fused" if use_kernel else "einsum"
     if use_kernel:
-        return _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret)
+        return _ring_fused(q, k, v, axis_name, causal, sm_scale, interpret,
+                           blocks[0], blocks[1])
 
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)

@@ -314,12 +314,35 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def _render_train_step(step: dict, fmt: str) -> int:
+    """Phase table for one profiled train step (trace --train-step)."""
+    total_ms = max(0.0, step["end"] - step["start"]) * 1e3
+    if fmt == "json":
+        print(json.dumps(step, indent=2, default=str))
+        return 0
+    print(f"train step  {total_ms:.2f}ms  (trace {step['trace_id']})")
+    print(f"  {'phase':<16} {'ms':>10} {'% of step':>10}")
+    for c in step.get("children", []):
+        dur_ms = max(0.0, c["end"] - c["start"]) * 1e3
+        pct = 100.0 * dur_ms / total_ms if total_ms else 0.0
+        print(f"  {c['name']:<16} {dur_ms:>10.2f} {pct:>9.1f}%")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Assemble one distributed trace from the head's timeline and print
     it as an indented span tree (or JSON)."""
-    from ray_tpu.util.tracing import assemble_trace
+    from ray_tpu.util.tracing import assemble_trace, latest_train_step
     address = load_address(args.address)
     events = _client(address).call("timeline_dump")
+    if getattr(args, "train_step", False):
+        step = latest_train_step(events)
+        if step is None:
+            print("no train_step spans in the timeline (run "
+                  "train.profile_train_step, then wait for the worker's "
+                  "telemetry flush)", file=sys.stderr)
+            return 1
+        return _render_train_step(step, args.format)
     roots = assemble_trace(events, trace_id=args.trace_id or "",
                            task_id=args.task_id or "")
     if not roots:
@@ -434,6 +457,9 @@ def main(argv=None) -> int:
     sp.add_argument("--trace-id", default="")
     sp.add_argument("--task-id", default="",
                     help="resolve the trace via this task's exec span")
+    sp.add_argument("--train-step", action="store_true",
+                    help="show the latest profiled train step's phase "
+                         "breakdown (train.profile_train_step)")
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_trace)
 

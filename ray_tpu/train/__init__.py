@@ -14,6 +14,8 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.result import Result
 from ray_tpu.train.session import (TrainContext, get_context, report,
                                    get_checkpoint, get_dataset_shard)
+from ray_tpu.train.step_profiler import (PHASES, StepBreakdown,
+                                         profile_train_step)
 from ray_tpu.train.train_step import make_train_step, shard_params
 from ray_tpu.train.trainer import JaxTrainer
 
@@ -22,4 +24,5 @@ __all__ = [
     "CheckpointConfig", "Checkpoint", "Result", "TrainContext",
     "get_context", "get_checkpoint", "get_dataset_shard", "report",
     "make_train_step", "shard_params",
+    "profile_train_step", "StepBreakdown", "PHASES",
 ]

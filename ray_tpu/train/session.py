@@ -96,6 +96,13 @@ class TrainContext:
             if flops and peak:
                 metrics_mod.train_mfu_gauge().set(
                     float(flops) / (dt * float(peak)))
+            phases = metrics.get("phases")
+            if isinstance(phases, dict):
+                # step-phase attribution (train.step_profiler breakdown,
+                # or any loop timing its own phases)
+                for phase, secs in phases.items():
+                    metrics_mod.train_phase_time_gauge().set(
+                        float(secs), tags={"phase": str(phase)})
         except Exception:  # noqa: BLE001
             pass
 

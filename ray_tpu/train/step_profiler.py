@@ -1,0 +1,177 @@
+"""Step-time attribution: split a train step into phases.
+
+The MFU number says *that* the step is slow, never *why* (ROADMAP item
+3: flat at ~48% for five bench rounds). XLA fuses the whole step into
+one program, so phases cannot be timed inside it; instead the profiler
+times separately-jitted sub-programs that share the step's math —
+
+  forward          jit(loss_fn)                     (loss only)
+  forward+backward jit(value_and_grad(loss_fn))     (adds the bwd pass)
+  optimizer        jit(update + apply_updates)      (optax step)
+
+backward = (fwd+bwd) − fwd. The fused step is then timed steady-state;
+the residual over fwd+bwd+opt is attributed to ``collective_wait`` —
+time the fused program spends blocked on collectives that the isolated
+(collective-light) sub-programs never wait for. When the fused step is
+FASTER than the sum (XLA overlapped work across phase boundaries), the
+compute phases are scaled proportionally so the breakdown always sums
+exactly to the measured step time — the invariant the smoke test pins.
+
+Compile time is reported separately (first fused call minus steady
+state) so warm-up can never leak into a steady-state MFU number.
+
+Results ride the existing telemetry planes: phase gauges
+(util.metrics.train_phase_time_gauge) and a train_step span tree in the
+task event buffer (visible via ``python -m ray_tpu trace --train-step``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict
+
+import jax
+
+PHASES = ("forward", "backward", "optimizer", "collective_wait")
+
+
+@dataclasses.dataclass
+class StepBreakdown:
+    """One profiled train step. ``phases`` (seconds, keyed by PHASES)
+    sums exactly to ``step_time_s``."""
+    step_time_s: float
+    compile_time_s: float
+    phases: Dict[str, float]
+    n_steps: int = 1
+
+    def phase_ms(self) -> Dict[str, float]:
+        return {k: v * 1e3 for k, v in self.phases.items()}
+
+    def as_metrics(self) -> Dict[str, Any]:
+        """The dict shape train.report() understands (session emits the
+        `phases` sub-dict through train_phase_time_gauge)."""
+        return {"step_time_s": self.step_time_s,
+                "compile_time_s": self.compile_time_s,
+                "phases": dict(self.phases)}
+
+
+def _timed(fn: Callable, *args, steps: int, warmup: int) -> float:
+    """Median steady-state wall time of fn(*args) (block_until_ready)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def profile_train_step(loss_fn: Callable[[Any, Any], jax.Array],
+                       optimizer, params, opt_state, batch, *,
+                       steps: int = 3, warmup: int = 1,
+                       emit: bool = True) -> StepBreakdown:
+    """Profile one train step configuration and return its breakdown.
+
+    loss_fn(params, batch) -> scalar; optimizer: optax transformation;
+    params/opt_state/batch: live (sharded) arrays — none are donated, so
+    the caller's training state is untouched. With emit=True the phase
+    gauges are set and a train_step span tree is recorded (best-effort,
+    no-ops outside a connected worker).
+    """
+    import optax
+
+    fwd = jax.jit(loss_fn)
+    vag = jax.jit(jax.value_and_grad(loss_fn))
+
+    @jax.jit
+    def opt_step(grads, opt_state, params):
+        updates, new_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    @jax.jit
+    def full_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # compile + first-call timing for the fused program
+    t0 = time.perf_counter()
+    jax.block_until_ready(full_step(params, opt_state, batch))
+    first_call_s = time.perf_counter() - t0
+    step_s = _timed(full_step, params, opt_state, batch,
+                    steps=steps, warmup=max(warmup - 1, 0))
+    compile_s = max(first_call_s - step_s, 0.0)
+
+    t_fwd = _timed(fwd, params, batch, steps=steps, warmup=warmup)
+    t_fwdbwd = _timed(vag, params, batch, steps=steps, warmup=warmup)
+    t_bwd = max(t_fwdbwd - t_fwd, 0.0)
+    _, grads = vag(params, batch)
+    t_opt = _timed(opt_step, grads, opt_state, params,
+                   steps=steps, warmup=warmup)
+
+    compute = t_fwd + t_bwd + t_opt
+    if compute <= step_s or compute <= 0:
+        # residual: fused-step time the isolated sub-programs never see —
+        # collective stalls (and any fusion overhead) live here
+        phases = {"forward": t_fwd, "backward": t_bwd, "optimizer": t_opt,
+                  "collective_wait": step_s - compute}
+    else:
+        # fused step beat the sum (XLA overlapped across phase borders):
+        # scale the compute phases onto the step so the sum stays exact
+        scale = step_s / compute
+        phases = {"forward": t_fwd * scale, "backward": t_bwd * scale,
+                  "optimizer": t_opt * scale, "collective_wait": 0.0}
+
+    breakdown = StepBreakdown(step_time_s=step_s, compile_time_s=compile_s,
+                              phases=phases, n_steps=steps)
+    if emit:
+        _emit_gauges(breakdown)
+        _record_spans(breakdown)
+    return breakdown
+
+
+def _emit_gauges(b: StepBreakdown) -> None:
+    try:
+        from ray_tpu.util import metrics as metrics_mod
+        metrics_mod.train_step_time_gauge().set(b.step_time_s)
+        for phase, secs in b.phases.items():
+            metrics_mod.train_phase_time_gauge().set(
+                secs, tags={"phase": phase})
+    except Exception:  # noqa: BLE001 — telemetry never fails profiling
+        pass
+
+
+def _record_spans(b: StepBreakdown) -> None:
+    """train_step parent span + one child per phase into the task event
+    buffer (flushed by telemetry to the head's timeline like any task
+    span — `python -m ray_tpu trace --train-step` renders it)."""
+    try:
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.util import trace_context
+        buf = getattr(getattr(global_worker, "backend", None),
+                      "event_buffer", None)
+        if buf is None:
+            return
+        end = time.time()
+        start = end - b.step_time_s
+        ctx = trace_context.current()
+        trace_id, parent = ctx if ctx else ("", "")
+        trace_id = trace_id or trace_context.new_trace_id()
+        step_sid = trace_context.new_span_id()
+        buf.record(name="train_step", task_id="train_step_profile",
+                   kind="train_step", start=start, end=end, ok=True,
+                   trace_id=trace_id, span_id=step_sid,
+                   parent_span_id=parent or "")
+        t = start
+        for phase in PHASES:
+            dt = b.phases.get(phase, 0.0)
+            buf.record(name=phase, task_id="train_step_profile",
+                       kind="train_phase", start=t, end=t + dt, ok=True,
+                       trace_id=trace_id,
+                       span_id=trace_context.new_span_id(),
+                       parent_span_id=step_sid)
+            t += dt
+    except Exception:  # noqa: BLE001
+        pass

@@ -231,6 +231,17 @@ def train_mfu_gauge() -> Gauge:
                  description="model FLOPs utilization (0..1, rank 0)")
 
 
+def train_phase_time_gauge() -> Gauge:
+    """Per-phase share of the train step (rank 0), tagged
+    phase=forward|backward|optimizer|collective_wait — the attribution
+    that makes the MFU plateau diagnosable (train.step_profiler, or a
+    loop reporting a `phases` dict through train.report)."""
+    return Gauge("train_phase_time_s",
+                 description="seconds per step spent in each train phase "
+                             "(rank 0)",
+                 tag_keys=("phase",))
+
+
 def llm_kv_page_utilization_gauge() -> Gauge:
     """Fraction of the paged KV pool's allocatable pages (all but the
     scratch page) currently held by sequences or the prefix cache."""

@@ -149,6 +149,30 @@ def assemble_trace(events: List[Dict[str, Any]],
     return roots
 
 
+def latest_train_step(events: List[Dict[str, Any]]
+                      ) -> Optional[Dict[str, Any]]:
+    """The most recent ``train_step`` span tree (train.step_profiler
+    records one per profile: a train_step parent whose train_phase
+    children partition the step window), or None. Behind
+    ``python -m ray_tpu trace --train-step``."""
+    steps = [e for e in events if e.get("kind") == "train_step"]
+    if not steps:
+        return None
+    newest = max(steps, key=lambda e: e.get("end", 0.0))
+    tid, sid = _span_ids(newest)
+    for root in assemble_trace(events, trace_id=tid):
+        for span in _walk(root):
+            if span["span_id"] == sid:
+                return span
+    return None
+
+
+def _walk(span):
+    yield span
+    for c in span.get("children", ()):
+        yield from _walk(c)
+
+
 def _fetch_events() -> List[Dict[str, Any]]:
     worker = require_connected()
     head = getattr(worker.backend, "head", None)

@@ -26,6 +26,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "`-m 'not slow'` sweep")
+
+
 @pytest.fixture
 def rtpu_local():
     import ray_tpu

@@ -1,5 +1,6 @@
 """Model-family tests on the virtual 8-device CPU mesh."""
 
+import dataclasses
 import functools
 
 import numpy as np
@@ -179,3 +180,113 @@ class TestMLP:
         params = jax.tree.map(lambda p, gi: p - 0.1 * gi, params, g)
         l1, _ = lg(params, (x, y))
         assert float(l1) < float(l0)
+
+
+class TestRematPolicies:
+    """remat_policy must be a pure speed/memory lever: every policy
+    computes identical losses AND gradients (ISSUE 7 parity guard)."""
+
+    def _loss_and_grads(self, policy):
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32,
+                                     remat_policy=policy)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = make_inputs(cfg, B=2, L=16)
+        loss, grads = jax.jit(jax.value_and_grad(
+            functools.partial(llama.loss_fn, cfg=cfg)))(params, tokens)
+        return float(loss), grads
+
+    def test_policies_identical_loss_and_grads(self):
+        ref_loss, ref_grads = self._loss_and_grads("full")
+        for policy in ("dots", "selective"):
+            loss, grads = self._loss_and_grads(policy)
+            assert loss == pytest.approx(ref_loss, abs=1e-6), policy
+            for got, ref in zip(jax.tree.leaves(grads),
+                                jax.tree.leaves(ref_grads)):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(ref),
+                    rtol=1e-5, atol=1e-6, err_msg=policy)
+
+    def test_unknown_policy_raises(self):
+        with pytest.raises(ValueError, match="remat_policy"):
+            llama.remat_policy_fn("nope")
+
+
+class TestFsdpOverlap:
+    """Explicit prefetch-scheduled fsdp step vs the GSPMD-auto step:
+    same loss, same grads — the overlap schedule only moves collectives,
+    never the math (ISSUE 7 numeric-parity acceptance)."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return build_mesh(MeshSpec(dp=2, fsdp=4))
+
+    def _place(self, cfg, mesh, B=8, L=16):
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        specs = llama.param_specs(cfg)
+        params = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P)))
+        tokens = jax.device_put(
+            make_inputs(cfg, B, L),
+            NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        return params, tokens
+
+    def test_overlap_loss_and_grads_match_gspmd(self, mesh):
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+        params, tokens = self._place(cfg, mesh)
+        cfg_ov = dataclasses.replace(cfg, fsdp_overlap=True)
+        vag = lambda c: jax.jit(jax.value_and_grad(functools.partial(
+            llama.loss_fn, cfg=c, mesh=mesh)))
+        l_ref, g_ref = vag(cfg)(params, tokens)
+        l_ov, g_ov = vag(cfg_ov)(params, tokens)
+        assert float(l_ov) == pytest.approx(float(l_ref), abs=1e-5)
+        for got, ref in zip(jax.tree.leaves(g_ov), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_overlap_composes_with_selective_remat(self, mesh):
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32,
+                                     remat_policy="selective")
+        params, tokens = self._place(cfg, mesh)
+        cfg_ov = dataclasses.replace(cfg, fsdp_overlap=True)
+        l_ref = jax.jit(functools.partial(
+            llama.loss_fn, cfg=cfg, mesh=mesh))(params, tokens)
+        l_ov = jax.jit(functools.partial(
+            llama.loss_fn, cfg=cfg_ov, mesh=mesh))(params, tokens)
+        assert float(l_ov) == pytest.approx(float(l_ref), abs=1e-5)
+
+    def test_overlap_rejects_tp_sharding(self):
+        mesh = build_mesh(MeshSpec(fsdp=2, tp=2, dp=2))
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32,
+                                     fsdp_overlap=True)
+        params, tokens = self._place(cfg, mesh, B=4)
+        with pytest.raises(ValueError, match="fsdp_overlap"):
+            jax.jit(functools.partial(
+                llama.loss_fn, cfg=cfg, mesh=mesh))(params, tokens)
+
+    def test_overlap_noop_when_fsdp_unsharded(self):
+        # fsdp=1 mesh: the flag must route to the normal GSPMD path
+        mesh = build_mesh(MeshSpec(dp=8))
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32,
+                                     fsdp_overlap=True)
+        params, tokens = self._place(cfg, mesh)
+        loss = jax.jit(functools.partial(
+            llama.loss_fn, cfg=cfg, mesh=mesh))(params, tokens)
+        assert np.isfinite(float(loss))
+
+
+class TestInt8MLP:
+    def test_int8_flag_changes_path_but_stays_finite(self):
+        cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+        cfg8 = dataclasses.replace(cfg, int8_mlp=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = make_inputs(cfg, B=2, L=16)
+        l_fp, g_fp = jax.jit(jax.value_and_grad(functools.partial(
+            llama.loss_fn, cfg=cfg)))(params, tokens)
+        l_8, g_8 = jax.jit(jax.value_and_grad(functools.partial(
+            llama.loss_fn, cfg=cfg8)))(params, tokens)
+        assert np.isfinite(float(l_8))
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree.leaves(g_8))
+        # quantized path is close to fp (W8A8 dynamic quant, tiny model)
+        assert float(l_8) == pytest.approx(float(l_fp), rel=0.05)

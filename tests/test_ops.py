@@ -101,3 +101,118 @@ class TestFlashAttention:
             ops.flash_attention = orig
         np.testing.assert_allclose(np.asarray(fl), np.asarray(full),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestBlockAutotune:
+    @pytest.fixture(autouse=True)
+    def _clean_cache(self):
+        import importlib
+        fa = importlib.import_module("ray_tpu.ops.flash_attention")
+        fa.clear_block_cache()
+        yield
+        fa.clear_block_cache()
+
+    def test_pick_block_floor(self):
+        import importlib
+        pick_block = importlib.import_module(
+            "ray_tpu.ops.flash_attention").pick_block
+        assert pick_block(256) == 256
+        assert pick_block(20) is None        # no divisor >= 8
+        assert pick_block(4) is None         # below the Mosaic floor
+        assert pick_block(4, min_block=1) == 4   # interpret-only escape
+
+    def test_candidates_respect_floor_and_divisibility(self):
+        import importlib
+        block_candidates = importlib.import_module(
+            "ray_tpu.ops.flash_attention").block_candidates
+        cands = block_candidates(2048, 2048, 64)
+        assert cands, "L=2048 must have candidates"
+        assert cands[0] == (256, 256)        # heuristic-best first
+        for bq, bk in cands:
+            assert bq >= 8 and bk >= 8
+            assert 2048 % bq == 0 and 2048 % bk == 0
+
+    def test_autotune_measures_and_caches(self, monkeypatch):
+        import importlib
+        fa = importlib.import_module("ray_tpu.ops.flash_attention")
+        calls = []
+
+        def fake_timer(Lq, Lk, D, dtype, bq, bk, **kw):
+            calls.append((bq, bk))
+            return abs(bq - 64) + abs(bk - 32)   # makes (64, 32) win
+
+        monkeypatch.setattr(fa, "_time_blocks", fake_timer)
+        best = fa.autotune_blocks(128, 64, 32, jnp.float32, measure=True)
+        assert best == (64, 32)
+        assert calls, "measure=True must actually time candidates"
+        assert fa.get_tuned_blocks(128, 64, 32, jnp.float32) == (64, 32)
+        # second call is a pure cache hit: no further timing
+        n = len(calls)
+        assert fa.autotune_blocks(128, 64, 32, jnp.float32,
+                                  measure=True) == (64, 32)
+        assert len(calls) == n
+
+    def test_autotune_heuristic_without_measure(self):
+        import importlib
+        fa = importlib.import_module("ray_tpu.ops.flash_attention")
+        assert fa.autotune_blocks(2048, 2048, 64, jnp.bfloat16,
+                                  measure=False) == (256, 256)
+
+    def test_autotune_indivisible_returns_none(self):
+        import importlib
+        fa = importlib.import_module("ray_tpu.ops.flash_attention")
+        assert fa.autotune_blocks(20, 20, 64, jnp.float32,
+                                  measure=False) is None
+
+    def test_flash_attention_uses_tuned_blocks(self, monkeypatch):
+        """blk_q/blk_k=None routes through the tuned cache (the sharded
+        wrappers pass None so every trace picks the autotuned block)."""
+        import importlib
+        fa = importlib.import_module("ray_tpu.ops.flash_attention")
+        q, k, v = make_qkv(B=1, L=64, H=2, D=32)
+        fa._BLOCK_CACHE[fa._block_cache_key(64, 64, 32, q.dtype)] = (32, 32)
+        seen = {}
+        real = fa._fwd_call
+
+        def spy(q_, k_, v_, causal, scale, blk_q, blk_k, interpret):
+            seen["blocks"] = (blk_q, blk_k)
+            return real(q_, k_, v_, causal, scale, blk_q, blk_k, interpret)
+
+        monkeypatch.setattr(fa, "_fwd_call", spy)
+        fa.flash_attention(q, k, v, blk_q=None, blk_k=None, interpret=True)
+        assert seen["blocks"] == (32, 32)
+
+
+class TestInt8Matmul:
+    def test_forward_close_to_fp(self):
+        from ray_tpu.ops import int8_matmul
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        w = jax.random.normal(jax.random.PRNGKey(1), (128, 32))
+        got = np.asarray(int8_matmul(x, w))
+        ref = np.asarray(x @ w)
+        rel = np.abs(got - ref).max() / np.abs(ref).max()
+        assert rel < 0.02, rel    # dynamic W8A8: ~1% at these shapes
+
+    def test_grads_are_exact_fp_transpose(self):
+        """The straight-through backward uses fp transposes of the ORIGINAL
+        operands, so grads equal the fp matmul's grads exactly."""
+        from ray_tpu.ops import int8_matmul
+        x = jax.random.normal(jax.random.PRNGKey(2), (16, 32))
+        w = jax.random.normal(jax.random.PRNGKey(3), (32, 8))
+        g = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+        loss8 = lambda x, w: (int8_matmul(x, w) * g).sum()
+        lossfp = lambda x, w: ((x @ w) * g).sum()
+        gx8, gw8 = jax.grad(loss8, argnums=(0, 1))(x, w)
+        gxf, gwf = jax.grad(lossfp, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx8), np.asarray(gxf),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gw8), np.asarray(gwf),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_jit_and_finite(self):
+        from ray_tpu.ops import int8_matmul
+        x = jax.random.normal(jax.random.PRNGKey(5), (8, 16))
+        w = jax.random.normal(jax.random.PRNGKey(6), (16, 4))
+        out = jax.jit(int8_matmul)(x, w)
+        assert out.shape == (8, 4)
+        assert np.isfinite(np.asarray(out)).all()

@@ -343,3 +343,34 @@ class TestRingAttentionFused:
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=5e-4)
+
+    def test_compiled_floor_degrades_to_einsum(self, sp_mesh, monkeypatch):
+        """ISSUE 7 regression: per-shard length below the Mosaic >= 8
+        sublane floor must NEVER pick a compiled block (the old
+        min_block=1 call handed Pallas an illegal 4-row block); the
+        request degrades to einsum with the fallback warning. The same
+        shape in interpret mode (no Mosaic tiling) still runs fused."""
+        import warnings as _w
+
+        import importlib
+
+        fa = importlib.import_module("ray_tpu.ops.flash_attention")
+        ra = importlib.import_module("ray_tpu.parallel.ring_attention")
+        monkeypatch.setattr(fa, "kernels_supported", lambda *a: True)
+        B, L, H, D = 1, 16, 2, 8   # 4 per sp=4 shard: below the floor
+        q = jax.random.normal(jax.random.PRNGKey(5), (B, L, H, D))
+        with _w.catch_warnings(record=True) as got:
+            _w.simplefilter("always")
+            out = ring_attention_sharded(q, q, q, mesh=sp_mesh,
+                                         use_kernel=True)
+        assert ra.last_ring_path() == "einsum"
+        assert any(issubclass(w.category, ra.RingAttentionFallbackWarning)
+                   for w in got)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(naive_causal_attention(q, q, q)),
+            rtol=2e-4, atol=2e-4)
+        # interpret mode has no sublane floor: the same shard length
+        # traces the fused program
+        ring_attention_sharded(q, q, q, mesh=sp_mesh,
+                               use_kernel=True, interpret=True)
+        assert ra.last_ring_path() == "fused"

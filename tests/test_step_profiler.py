@@ -1,0 +1,149 @@
+"""Step-time attribution smoke tests (ISSUE 7 CI guard): the phase
+breakdown must sum to the measured step time, ride the train gauges, and
+land in the task event buffer as a train_step span tree — so the
+profiler itself can't silently rot."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.train import PHASES, StepBreakdown, profile_train_step
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import optax
+    cfg = llama.LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    loss = functools.partial(llama.loss_fn, cfg=cfg)
+    return loss, opt, params, opt_state, tokens
+
+
+class TestStepProfiler:
+    def test_breakdown_sums_to_step_time(self, setup):
+        loss, opt, params, opt_state, tokens = setup
+        bd = profile_train_step(loss, opt, params, opt_state, tokens,
+                                steps=2, warmup=1, emit=False)
+        assert isinstance(bd, StepBreakdown)
+        assert set(bd.phases) == set(PHASES)
+        assert all(v >= 0.0 for v in bd.phases.values())
+        assert bd.step_time_s > 0.0
+        assert bd.compile_time_s >= 0.0
+        # the invariant the attribution maintains by construction
+        assert sum(bd.phases.values()) == pytest.approx(
+            bd.step_time_s, rel=1e-6)
+        # phase_ms mirrors phases in milliseconds
+        assert bd.phase_ms()["forward"] == pytest.approx(
+            bd.phases["forward"] * 1e3)
+
+    def test_profile_does_not_touch_training_state(self, setup):
+        loss, opt, params, opt_state, tokens = setup
+        before = float(loss(params, tokens))
+        profile_train_step(loss, opt, params, opt_state, tokens,
+                           steps=1, warmup=0, emit=False)
+        assert float(loss(params, tokens)) == pytest.approx(before)
+
+    def test_gauges_emitted(self, setup):
+        from ray_tpu.util import metrics
+        loss, opt, params, opt_state, tokens = setup
+        metrics.clear_registry()
+        try:
+            profile_train_step(loss, opt, params, opt_state, tokens,
+                               steps=1, warmup=0, emit=True)
+            snap = metrics.snapshot()
+            assert "train_phase_time_s" in snap
+            tagged = snap["train_phase_time_s"]["values"]
+            assert {k[0] for k in tagged} == set(PHASES)
+            assert "train_step_time_s" in snap
+        finally:
+            metrics.clear_registry()
+
+    def test_spans_recorded_and_cli_selectable(self, setup, monkeypatch):
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.runtime.events import TaskEventBuffer
+        from ray_tpu.util.tracing import latest_train_step
+
+        loss, opt, params, opt_state, tokens = setup
+        buf = TaskEventBuffer()
+
+        class FakeBackend:
+            event_buffer = buf
+
+        monkeypatch.setattr(worker_mod.global_worker, "backend",
+                            FakeBackend(), raising=False)
+        bd = profile_train_step(loss, opt, params, opt_state, tokens,
+                                steps=1, warmup=0, emit=True)
+        events = buf.drain()
+        steps = [e for e in events if e["kind"] == "train_step"]
+        assert len(steps) == 1
+        phases = [e for e in events if e["kind"] == "train_phase"]
+        assert {e["name"] for e in phases} == set(PHASES)
+        assert all(e["parent_span_id"] == steps[0]["span_id"]
+                   for e in phases)
+        # children partition the parent window (abs tolerance: the span
+        # window lives on unix-epoch floats, which can't hold rel=1e-6
+        # of a millisecond step)
+        assert steps[0]["end"] - steps[0]["start"] == pytest.approx(
+            bd.step_time_s, abs=1e-3)
+        # the CLI's --train-step selector finds the tree
+        tree = latest_train_step(events)
+        assert tree is not None and tree["name"] == "train_step"
+        assert {c["name"] for c in tree["children"]} == set(PHASES)
+
+    def test_report_phases_rides_session_gauges(self, tmp_path):
+        from ray_tpu.train.session import TrainContext
+        from ray_tpu.util import metrics
+        metrics.clear_registry()
+        try:
+            ctx = TrainContext(rank=0, world_size=1,
+                               storage_path=str(tmp_path))
+            ctx.report({"loss": 1.0})  # first report only arms the clock
+            ctx.report({"loss": 0.9,
+                        "phases": {"forward": 0.25, "backward": 0.5}})
+            tagged = metrics.snapshot()["train_phase_time_s"]["values"]
+            assert tagged[("forward",)] == pytest.approx(0.25)
+            assert tagged[("backward",)] == pytest.approx(0.5)
+        finally:
+            metrics.clear_registry()
+
+
+@pytest.mark.slow
+class TestRematPolicyTiming:
+    def test_selective_backward_not_slower_than_full(self):
+        """The lever's direction on CPU: selective remat (saves matmul
+        outputs) must not lose to full remat (recomputes the whole layer
+        in backward). Generous margin — this guards the sign, not the
+        magnitude."""
+        import time
+        cfg_full = llama.LlamaConfig.tiny(
+            dim=128, n_layers=4, ffn_dim=512, dtype=jnp.float32,
+            remat_policy="full")
+        cfg_sel = llama.LlamaConfig.tiny(
+            dim=128, n_layers=4, ffn_dim=512, dtype=jnp.float32,
+            remat_policy="selective")
+        params = llama.init_params(cfg_full, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 128), 0,
+                                    cfg_full.vocab_size)
+
+        def timed(cfg):
+            fn = jax.jit(jax.value_and_grad(
+                functools.partial(llama.loss_fn, cfg=cfg)))
+            jax.block_until_ready(fn(params, tokens))
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(params, tokens))
+                times.append(time.perf_counter() - t0)
+            return sorted(times)[len(times) // 2]
+
+        t_full, t_sel = timed(cfg_full), timed(cfg_sel)
+        assert t_sel <= t_full * 1.1, (t_sel, t_full)
