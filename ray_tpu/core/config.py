@@ -72,6 +72,8 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_step_token_budget": (int, 2048, "max prefill tokens scheduled per engine step (decode-priority continuous batching); 0 = unbounded"),
     "llm_admit_lookahead": (int, 16, "waiting requests scanned past a non-admittable head for same-bucket/admissible prompts (head-of-line fix)"),
     "llm_admit_age_cap_s": (float, 5.0, "a head request older than this stops lookahead skipping so freed pages go to it first (no starvation)"),
+    "llm_kv_dtype": (str, "model", "KV page storage scheme: 'model' (engine dtype) or 'int8' (quantized pages + bf16 per-token scales; ~1.9x concurrent sequences per HBM byte at head_dim 64)"),
+    "llm_ragged_prefill_rows": (int, 2, "prefill-chunk rows packed into each ragged step dispatch (ragged token capacity = max_batch + rows*prefill_chunk); more rows advance more prompts per step at the cost of padding when the queue is shallow"),
     # --- misc ---
     "session_dir": (str, "/tmp/ray_tpu", "root for session artifacts"),
     "log_to_driver": (bool, True, "forward worker logs to driver"),

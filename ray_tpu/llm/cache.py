@@ -104,12 +104,20 @@ class PageAllocator:
                 self._ref[p] = ref - 1
 
 
-def hash_token_blocks(prompt: List[int], page_size: int) -> List[int]:
+def hash_token_blocks(prompt: List[int], page_size: int,
+                      kv_tag: str = "") -> List[int]:
     """Chain hashes of the prompt's FULL token blocks: block i's hash
     folds in block i-1's, so equal hashes mean equal page-aligned
-    prefixes (vLLM's block hash chain)."""
+    prefixes (vLLM's block hash chain).
+
+    ``kv_tag`` seeds the chain with the KV page dtype/quantization
+    scheme (e.g. "bfloat16" vs "int8"): a page's BYTES depend on how
+    the pool stores KV, so pages written under one scheme must never
+    hash-match a lookup under another — same tokens, different
+    (incompatible) cache contents.
+    """
     out: List[int] = []
-    h = 0x9E3779B9
+    h = hash((0x9E3779B9, kv_tag))
     for i in range(len(prompt) // page_size):
         block = tuple(prompt[i * page_size:(i + 1) * page_size])
         h = hash((h, block))
@@ -128,9 +136,11 @@ class PrefixCache:
     ``note_release`` like any other sequence page.
     """
 
-    def __init__(self, allocator: PageAllocator, page_size: int):
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 kv_tag: str = ""):
         self.allocator = allocator
         self.page_size = page_size
+        self.kv_tag = kv_tag        # KV dtype/quant scheme, in the hash
         self._pages: Dict[int, int] = {}          # block hash -> page id
         self._hash_of: Dict[int, int] = {}        # page id -> block hash
         # evictable pages (cache holds the only reference), LRU order
@@ -163,7 +173,7 @@ class PrefixCache:
         """
         self.lookups += 1
         pages: List[int] = []
-        for h in hash_token_blocks(prompt, self.page_size):
+        for h in hash_token_blocks(prompt, self.page_size, self.kv_tag):
             p = self._pages.get(h)
             if p is None:
                 break
@@ -187,7 +197,8 @@ class PrefixCache:
         chain hashes (one cache reference per newly published page).
         Already-published hashes (the pages this prompt itself hit) are
         left as-is."""
-        for i, h in enumerate(hash_token_blocks(prompt, self.page_size)):
+        for i, h in enumerate(hash_token_blocks(prompt, self.page_size,
+                                                self.kv_tag)):
             if i >= len(pages):
                 break
             if h in self._pages:
@@ -223,12 +234,37 @@ class PrefixCache:
 
 
 def make_kv_cache(cfg: LlamaConfig, total_pages: int, page_size: int,
-                  dtype=None):
-    """[n_layers, total_pages, Hkv, page_size, D] x 2, device-resident."""
-    dtype = dtype or cfg.dtype
+                  dtype=None, kv_dtype: Optional[str] = None):
+    """Device-resident paged KV pool as a dict pytree.
+
+    {"k", "v"}: [n_layers, total_pages, Hkv, page_size, D]. With
+    ``kv_dtype="int8"`` the pools are int8 and {"k_scale", "v_scale"}
+    [n_layers, total_pages, Hkv, page_size] bf16 per-(page, head, slot)
+    dequant scales ride alongside — one pytree, so jit donation,
+    shard_map specs and COW copies treat pages + scales as one unit.
+    ``kv_dtype`` in {None/"model" (cfg dtype), "int8"}.
+    """
+    if kv_dtype not in (None, "model", "int8"):
+        raise ValueError(f"kv_dtype must be 'model' or 'int8', "
+                         f"got {kv_dtype!r}")
     shape = (cfg.n_layers, total_pages, cfg.n_kv_heads, page_size,
              cfg.head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    if kv_dtype == "int8":
+        from ray_tpu.ops.int8 import KV_SCALE_DTYPE
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], KV_SCALE_DTYPE),
+                "v_scale": jnp.zeros(shape[:-1], KV_SCALE_DTYPE)}
+    dtype = dtype or cfg.dtype
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def kv_cache_tag(cfg: LlamaConfig, kv_dtype: Optional[str]) -> str:
+    """The PrefixCache hash seed for a pool config: pages written under
+    one KV storage scheme must never match a lookup under another."""
+    if kv_dtype == "int8":
+        return "int8"
+    return str(jnp.dtype(cfg.dtype).name)
 
 
 class SequenceState:

@@ -2,37 +2,44 @@
 
 Role-equivalent to the reference's vLLM engine integration (reference:
 llm/_internal/serve/deployments/llm/vllm/vllm_engine.py — engine loop,
-admission, scheduling), rebuilt TPU-first:
+admission, scheduling), rebuilt TPU-first around ONE ragged step:
 
-  - ONE compiled decode program: the decode batch has a fixed shape
-    (max_batch slots); empty slots point at the scratch page, so joining
-    and leaving sequences never changes the program (XLA recompiles on
-    shape change — the cardinal sin of TPU serving loops);
-  - prompts prefill in same-length-bucket GROUPS through a bucketed jit
-    (prompt padded to the next power-of-two length bucket, group padded
-    to a power-of-two size: compile count stays |len buckets| x |size
-    buckets|), then each sequence's K/V is written into its pages and it
-    joins the decode batch;
+  - RAGGED SINGLE-DISPATCH STEP: every scheduler step packs the decode
+    batch (one token per running sequence) and up to prefill_rows
+    prefill CHUNKS (bounded by the step token budget) into one ragged
+    token batch and runs ONE compiled program
+    (model._ragged_step_body over ops.ragged_paged_attention). The old
+    engine compiled a per-length-bucket zoo — |len buckets| x |size
+    buckets| prefill programs plus a chunk program per chunk length
+    plus a separate decode program; this engine compiles O(1) programs
+    total (mixed step, decode loop, COW page copy — asserted <= 3), and
+    XLA never recompiles as sequences join, leave, or chunk (shape
+    change is the cardinal sin of TPU serving loops);
+  - pure-decode steps (no prefill work pending) run the multi-step
+    decode loop instead: decode_chunk ragged steps scanned in ONE
+    program with a single [K, B] readback, so steady-state decode pays
+    one host round trip per K tokens;
   - PREFIX CACHE: full prompt KV pages publish into a hash-indexed
-    table (llm/cache.py PrefixCache) — a new request whose prompt shares
-    a page-aligned prefix with a live or recently-finished sequence maps
-    those pages read-only (copy-on-write when the tail must write into a
-    shared page) and only prefills the tail, so thousand-user shared
-    system prompts stop paying full prefill;
-  - CHUNKED PREFILL: prompts (or uncached tails) longer than
-    prefill_chunk compute in bounded chunks (prefill_chunk_tok attends
-    to the prior paged KV) interleaved with decode steps under a
-    per-step token budget — decode-priority scheduling, so one 2k-token
-    prompt no longer stalls the running batch for a full prefill
-    dispatch;
-  - pages allocate refcounted with one page of decode headroom; under
-    allocator pressure the engine LRU-evicts unreferenced cached pages.
+    table (llm/cache.py PrefixCache, keyed by the KV storage scheme so
+    fp16 and int8 pages never cross-match) — a new request whose prompt
+    shares a page-aligned prefix maps those pages read-only
+    (copy-on-write when the tail must write into a shared page) and
+    only prefills the tail;
+  - CHUNKED PREFILL: every prompt computes in prefill_chunk-bounded
+    chunks riding the mixed step under the per-step token budget —
+    decode-priority scheduling, so one 2k-token prompt never stalls the
+    running batch behind a monolithic prefill dispatch;
+  - INT8 KV (kv_dtype="int8"): pages store int8 with bf16
+    per-(token, head) scales carried in the same kv pytree — ~1.9x the
+    concurrent sequences per HBM byte, quantize-on-write in the step
+    program, dequantize inside the attention kernel;
+  - pages allocate refcounted with decode headroom; under allocator
+    pressure the engine LRU-evicts unreferenced cached pages.
 """
 
 from __future__ import annotations
 
 import collections
-import functools
 import itertools
 import threading
 import time
@@ -43,97 +50,55 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.llm.cache import (SCRATCH_PAGE, PageAllocator, PrefixCache,
-                               SequenceState, make_kv_cache)
-from ray_tpu.llm.model import (copy_page, decode_loop, prefill,
-                               prefill_chunk_tok, prefill_many)
+                               SequenceState, kv_cache_tag, make_kv_cache)
+from ray_tpu.llm import model as M
 from ray_tpu.models.llama import LlamaConfig, init_params
-
-
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _prefill_tok(params, tokens, true_len, cfg):
-    """prefill + argmax in ONE compiled program: TTFT is round-trip-bound
-    (on a tunneled chip each blocking readback is ~120ms), so the first
-    token must come back in a single scalar read with no intermediate
-    eager dispatch between prefill and argmax."""
-    logits, k_all, v_all = prefill(params, tokens, true_len, cfg)
-    return jnp.argmax(logits), k_all, v_all
-
-
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _prefill_many_tok(params, tokens, true_lens, cfg):
-    logits, k_n, v_n = prefill_many(params, tokens, true_lens, cfg)
-    return jnp.argmax(logits, axis=-1), k_n, v_n
-
-
-@functools.partial(jax.jit, static_argnames=("t_page",),
-                   donate_argnames=("k_cache", "v_cache"))
-def _write_prefill_pages(k_cache, v_cache, k_all, v_all, true_len, pages,
-                         t_page):
-    """Stage the prompt K/V fully ON DEVICE and scatter into the pool.
-
-    k_all/v_all come straight from prefill (device arrays, padded length);
-    positions >= true_len are zeroed (padding garbage must not enter the
-    pool), then sliced/padded to t_page = len(pages)*page_size. No bytes
-    cross the host — a host round-trip here dominated TTFT on tunneled
-    chips. Caches are donated (no full-pool copy).
-    """
-    from ray_tpu.llm.model import stage_prefill_kv
-    return stage_prefill_kv(k_cache, v_cache, k_all, v_all, true_len,
-                            pages, t_page)
-
-
-@functools.partial(jax.jit, static_argnames=("t_page",),
-                   donate_argnames=("k_cache", "v_cache"))
-def _write_prefill_pages_group(k_cache, v_cache, k_n, v_n, true_lens,
-                               pages_n, t_page):
-    from ray_tpu.llm.model import stage_prefill_kv_group
-    return stage_prefill_kv_group(k_cache, v_cache, k_n, v_n, true_lens,
-                                  pages_n, t_page)
+from ray_tpu.ops.paged_attention import kernels_supported
 
 
 class _SingleChipFns:
-    """tp=1 dispatch: the module-level jits, signatures matching
+    """tp=1 dispatch: the module-level jits in llm.model (compile cache
+    shared across engines with equal shapes), signatures matching
     llm.tp.TPEngineFns so the engine swaps implementations at one seam."""
 
-    def __init__(self, cfg: LlamaConfig, decode_chunk: int):
+    def __init__(self, cfg: LlamaConfig, decode_chunk: int,
+                 max_q_len: int, decode_rows: int):
         self.cfg = cfg
         self._chunk = decode_chunk
+        self._max_q = max_q_len
+        self._rows = decode_rows
+        self._impl = "kernel" if kernels_supported() else "reference"
 
-    def prefill_tok(self, params, tokens, true_len):
-        return _prefill_tok(params, tokens, true_len, self.cfg)
+    def ragged_step(self, params, tokens, token_pos, token_page,
+                    token_slot, page_table, q_start, q_len, kv_len, kv):
+        return M.ragged_step(params, tokens, token_pos, token_page,
+                             token_slot, page_table, q_start, q_len,
+                             kv_len, kv, cfg=self.cfg,
+                             paged_impl=self._impl, max_q_len=self._max_q,
+                             decode_rows=self._rows)
 
-    def prefill_many_tok(self, params, tokens, true_lens):
-        return _prefill_many_tok(params, tokens, true_lens, self.cfg)
+    def decode_loop(self, params, tokens, positions, kv, page_table,
+                    seq_lens):
+        return M.ragged_decode_loop(params, tokens, positions, kv,
+                                    page_table, seq_lens,
+                                    num_steps=self._chunk, cfg=self.cfg,
+                                    paged_impl=self._impl)
 
-    def prefill_chunk_tok(self, params, tokens, pages, prior_len,
-                          valid_len, k_cache, v_cache):
-        return prefill_chunk_tok(params, tokens, pages, prior_len,
-                                 valid_len, k_cache, v_cache, self.cfg)
+    def copy_page(self, kv, src, dst):
+        return M.copy_page(kv, src, dst)
 
-    def copy_page(self, k_cache, v_cache, src, dst):
-        return copy_page(k_cache, v_cache, src, dst)
-
-    def write_prefill_pages(self, k_cache, v_cache, k_all, v_all,
-                            true_len, pages, t_page):
-        return _write_prefill_pages(k_cache, v_cache, k_all, v_all,
-                                    true_len, pages, t_page)
-
-    def write_prefill_pages_group(self, k_cache, v_cache, k_n, v_n,
-                                  true_lens, pages_n, t_page):
-        return _write_prefill_pages_group(k_cache, v_cache, k_n, v_n,
-                                          true_lens, pages_n, t_page)
-
-    def decode_loop(self, params, tokens, positions, k_cache, v_cache,
-                    page_table, seq_lens):
-        return decode_loop(params, tokens, positions, k_cache, v_cache,
-                           page_table, seq_lens, self._chunk, self.cfg)
-
-
-def _bucket(n: int, lo: int = 16) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+    def compiled_step_programs(self) -> int:
+        """Resident compiled step programs, process-wide (the three
+        module jits share their cache across engines): the O(1) compile
+        budget the ragged design promises. In a fresh process running
+        one engine this is exactly that engine's program count."""
+        n = 0
+        for f in (M.ragged_step, M.ragged_decode_loop, M.copy_page):
+            try:
+                n += f._cache_size()
+            except AttributeError:    # older jax: count the fn itself
+                n += 1
+        return n
 
 
 class InferenceEngine:
@@ -141,13 +106,14 @@ class InferenceEngine:
                  page_size: int = 16, total_pages: int = 256,
                  max_batch: int = 8, max_seq_len: int = 1024,
                  eos_token: Optional[int] = None, seed: int = 0,
-                 decode_chunk: int = 8, prefill_batch: int = 4,
-                 prefill_burst: Optional[int] = None,
+                 decode_chunk: int = 8,
                  prefix_cache: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
                  step_token_budget: Optional[int] = None,
                  admit_lookahead: Optional[int] = None,
                  admit_age_cap_s: Optional[float] = None,
+                 kv_dtype: Optional[str] = None,
+                 prefill_rows: Optional[int] = None,
                  tp: int = 1, devices=None):
         from ray_tpu.core.config import GlobalConfig
         self.cfg = cfg
@@ -157,21 +123,11 @@ class InferenceEngine:
         self.max_batch = max_batch
         self.max_pages_per_seq = -(-max_seq_len // page_size)
         self.eos_token = eos_token
-        # tokens decoded per device dispatch: each dispatch costs a full
-        # host<->device round trip (expensive over PCIe, brutal over a
-        # tunneled chip), so K steps ride one trip (vLLM multi-step
+        # tokens decoded per pure-decode dispatch: each dispatch costs a
+        # full host<->device round trip (expensive over PCIe, brutal over
+        # a tunneled chip), so K steps ride one trip (vLLM multi-step
         # scheduling); finished sequences overshoot at most K-1 tokens
         self.decode_chunk = max(1, decode_chunk)
-        # prompts admitted per prefill dispatch (same length bucket):
-        # amortizes dispatch + compute across a deep admission queue.
-        # prefill_batch bounds groups while sequences are DECODING (a big
-        # group stalls their next chunk); prefill_burst bounds the
-        # idle-batch burst (default: max_batch). Memory-tight configs
-        # whose prefill_batch exists to bound staged-KV peak should set
-        # prefill_burst to the same value.
-        self.prefill_batch = max(1, prefill_batch)
-        self.prefill_burst = max_batch if prefill_burst is None \
-            else max(1, prefill_burst)
         # scheduler knobs (None -> GlobalConfig llm_* defaults)
         self.prefill_chunk = max(
             1, GlobalConfig.llm_prefill_chunk if prefill_chunk is None
@@ -185,8 +141,22 @@ class InferenceEngine:
         self.admit_age_cap_s = \
             GlobalConfig.llm_admit_age_cap_s \
             if admit_age_cap_s is None else admit_age_cap_s
-        self.k_cache, self.v_cache = make_kv_cache(cfg, total_pages,
-                                                   page_size)
+        # ragged batch geometry: every mixed step carries max_batch
+        # decode rows (one per slot, inactive slots masked by q_len=0)
+        # plus prefill_rows chunk rows of up to prefill_chunk tokens —
+        # ONE static shape, so prompt mix never recompiles
+        self.prefill_rows = max(
+            1, GlobalConfig.llm_ragged_prefill_rows if prefill_rows is None
+            else prefill_rows)
+        self.ragged_rows = max_batch + self.prefill_rows
+        self.ragged_tokens = max_batch + self.prefill_rows \
+            * self.prefill_chunk
+        # KV page storage scheme: "model" (cfg dtype) or "int8"
+        # (quantized pages + bf16 per-token scales, ~1.9x capacity)
+        self.kv_dtype = GlobalConfig.llm_kv_dtype \
+            if kv_dtype is None else kv_dtype
+        self.kv = make_kv_cache(cfg, total_pages, page_size,
+                                kv_dtype=self.kv_dtype)
         # tensor parallelism: tp>1 shards weights + kv-heads over a
         # ('tp',) mesh and swaps in shard_map'd programs (llm/tp.py);
         # page allocator / slot bookkeeping below is layout-agnostic
@@ -195,21 +165,26 @@ class InferenceEngine:
         if self.tp > 1:
             from ray_tpu.llm.tp import TPEngineFns, build_tp_mesh
             self.mesh = build_tp_mesh(self.tp, devices)
-            self._fns = TPEngineFns(cfg, self.mesh, self.decode_chunk)
+            self._fns = TPEngineFns(
+                cfg, self.mesh, decode_chunk=self.decode_chunk,
+                max_q_len=self.prefill_chunk, decode_rows=max_batch,
+                kv_quantized=(self.kv_dtype == "int8"))
             self.params = self._fns.shard_params(self.params)
-            self.k_cache, self.v_cache = self._fns.shard_caches(
-                self.k_cache, self.v_cache)
+            self.kv = self._fns.shard_caches(self.kv)
         else:
-            self._fns = _SingleChipFns(cfg, self.decode_chunk)
+            self._fns = _SingleChipFns(cfg, self.decode_chunk,
+                                       self.prefill_chunk, max_batch)
         self.allocator = PageAllocator(total_pages)
         use_prefix = GlobalConfig.llm_prefix_cache \
             if prefix_cache is None else prefix_cache
         self.prefix: Optional[PrefixCache] = \
-            PrefixCache(self.allocator, page_size) if use_prefix else None
+            PrefixCache(self.allocator, page_size,
+                        kv_tag=kv_cache_tag(cfg, self.kv_dtype)) \
+            if use_prefix else None
         self.waiting: List[SequenceState] = []
         self.running: List[SequenceState] = []
         # admitted sequences still computing prompt KV in chunks; they
-        # hold a slot + pages but stay out of the decode batch
+        # hold a slot + pages but stay out of the decode rows
         self._chunking: List[SequenceState] = []
         self._slots: List[Optional[SequenceState]] = [None] * max_batch
         self._req_ids = itertools.count()
@@ -219,10 +194,11 @@ class InferenceEngine:
                                    SCRATCH_PAGE, np.int32)
         self._positions = np.zeros(max_batch, np.int32)
         self._tokens = np.zeros(max_batch, np.int32)
-        self.stats = {"prefill_tokens": 0, "prefill_dispatches": 0,
+        self.stats = {"steps": 0, "prefill_tokens": 0,
                       "decode_steps": 0, "decode_tokens": 0,
                       "decode_dispatches": 0, "cached_tokens": 0,
-                      "chunk_dispatches": 0, "cow_copies": 0}
+                      "ragged_dispatches": 0, "ragged_real_tokens": 0,
+                      "ragged_slot_tokens": 0, "cow_copies": 0}
         self._finished_at_prefill: Dict[str, List[int]] = {}
         # tokens generated since the last drain_progress() call, per live
         # request — the incremental surface token streaming rides on
@@ -247,8 +223,11 @@ class InferenceEngine:
         self._g_prefill_tps = metrics_mod.llm_prefill_tokens_per_s_gauge()
         self._g_decode_tps = metrics_mod.llm_decode_tokens_per_s_gauge()
         self._g_queue = metrics_mod.llm_queue_depth_gauge()
+        self._g_programs = metrics_mod.llm_compiled_programs_gauge()
+        self._g_dispatches = metrics_mod.llm_dispatches_per_step_gauge()
+        self._g_pad_waste = metrics_mod.llm_padding_waste_gauge()
         self._metrics_ts = time.monotonic()
-        self._metrics_last = (0, 0)   # (prefill_tokens, decode_tokens)
+        self._metrics_last = dict(self.stats)
 
     # ------------------------------------------------------------ requests
 
@@ -278,52 +257,32 @@ class InferenceEngine:
         with self._lock:
             return bool(self.waiting or self.running or self._chunking)
 
+    def compiled_step_programs(self) -> int:
+        """Compiled step programs resident for this engine's step fns
+        (O(1) by design: mixed ragged step, decode loop, COW copy)."""
+        return self._fns.compiled_step_programs()
+
     # ---------------------------------------------------------------- step
 
     def step(self) -> Dict[str, List[int]]:
-        """One scheduler step: bounded prefill work (chunk continuations
-        + admissions, under the step token budget), then one decode
-        chunk for the whole running batch. Returns {request_id:
-        generated} for sequences that FINISHED this step."""
-        self._schedule_prefill()
-        finished = self._decode()
+        """One scheduler step: admit waiting requests, then EITHER one
+        ragged mixed dispatch (prefill chunks under the token budget +
+        one decode token per running sequence, a single program) when
+        prefill work is pending, OR one multi-step decode-loop dispatch
+        (decode_chunk tokens per running sequence) when not. Returns
+        {request_id: generated} for sequences that FINISHED this step."""
+        finished: Dict[str, List[int]] = {}
+        self._admit()
+        if not self._ragged_dispatch(finished):
+            self._decode(finished)
         if self._finished_at_prefill:
             finished.update(self._finished_at_prefill)
             self._finished_at_prefill = {}
+        self.stats["steps"] += 1
         self._update_metrics()
         return finished
 
-    def _free_slot(self) -> Optional[int]:
-        for i, s in enumerate(self._slots):
-            if s is None:
-                return i
-        return None
-
     # ---------------------------------------------------------- scheduling
-
-    def _schedule_prefill(self) -> None:
-        """Decode-priority prefill scheduling: at most step_token_budget
-        prompt tokens compute per step, so the decode chunk that follows
-        is never starved behind unbounded prefill work. In-flight
-        chunked prefills continue first (they already hold pages and
-        slots), then new requests admit with what remains."""
-        budget = self.step_token_budget \
-            if self.step_token_budget > 0 else (1 << 30)
-        spent = 0
-        inflight = list(self._chunking)
-        for seq in inflight:
-            if spent >= budget:
-                break
-            spent += self._run_chunk(seq, budget - spent)
-        if spent >= budget:
-            return
-        spent += self._admit(budget - spent)
-        # first chunk of freshly admitted chunked sequences rides the
-        # same step (a prefix-hit tail should not wait a step for TTFT)
-        for seq in [s for s in self._chunking if s not in inflight]:
-            if spent >= budget:
-                break
-            spent += self._run_chunk(seq, budget - spent)
 
     def _alloc_pages(self, n: int) -> Optional[List[int]]:
         """Allocate, LRU-evicting unreferenced prefix-cache pages under
@@ -345,100 +304,63 @@ class InferenceEngine:
         if matched_pages:
             self._release_pages(matched_pages)
 
-    def _admit(self, budget: int) -> int:
-        """Admit waiting requests, two paths:
-
-        FAST: no cached prefix and the prompt fits one prefill_chunk —
-        same-length-bucket requests group into ONE batched prefill
-        dispatch (up to prefill_batch/prefill_burst), the original
-        TTFT-optimized path.
-
-        CHUNKED: a cached prefix exists (the tail must attend to prior
-        pages) or the prompt exceeds prefill_chunk — the sequence
-        reserves a slot + pages (copy-on-write if its tail writes into a
-        shared page) and its KV computes chunk-by-chunk interleaved with
-        decode steps.
+    def _admit(self) -> None:
+        """Admit waiting requests into the chunked-prefill pipeline: a
+        sequence reserves a decode slot + pages up front (prefix-cache
+        hits map shared pages read-only, copy-on-write if its tail
+        writes into a shared page) and its uncached prompt tail computes
+        chunk-by-chunk on the mixed ragged step. Admission itself costs
+        no device work, so it is not budgeted — chunk tokens are, as
+        their rows are packed.
 
         Head-of-line fix: the scan continues past non-admissible
-        requests (different compile bucket, no pages) through a bounded
-        lookahead window instead of breaking at the first mismatch — one
-        long prompt at the head no longer starves short prompts behind
-        it. Aging guard: once the head has waited admit_age_cap_s, a
-        head that fails for MEMORY stops the scan, so freed pages reach
-        it instead of being re-captured by younger requests forever.
-
-        Returns fast-path prompt tokens admitted (counted against the
-        step budget; chunked tails are budgeted as their chunks run)."""
-        group: List[Tuple[SequenceState, int, List[int]]] = []
-        chunked: List[Tuple[SequenceState, List[int], List[int], bool]] = []
-        spent = 0
+        requests (no free pages) through a bounded lookahead window
+        instead of breaking at the first failure — one long prompt at
+        the head no longer starves short prompts behind it. Aging
+        guard: once the head has waited admit_age_cap_s, a head that
+        fails for MEMORY stops the scan, so freed pages reach it
+        instead of being re-captured by younger requests forever."""
+        admitted: List[Tuple[SequenceState, List[int], List[int], bool]] = []
         with self._lock:
             if not self.waiting:
-                return 0
+                return
             now = time.monotonic()
-            cap = self.prefill_batch if self.running else self.prefill_burst
             head = self.waiting[0]
             head_aged = (now - head.enqueue_ts) > self.admit_age_cap_s
-            bucket: Optional[int] = None
             free_slots = [i for i, s in enumerate(self._slots)
                           if s is None]
             for seq in list(self.waiting[:self.admit_lookahead]):
-                if not free_slots or spent >= budget:
+                if not free_slots:
                     break
                 matched_pages: List[int] = []
                 matched, cow = 0, False
                 if self.prefix is not None:
                     matched_pages, matched, cow = \
                         self.prefix.match(seq.prompt)
-                tail = len(seq.prompt) - matched
-                if matched == 0 and tail <= self.prefill_chunk:
-                    # ---- fast path: whole-prompt bucketed group prefill
-                    if len(group) >= cap:
-                        continue
-                    b = _bucket(len(seq.prompt))
-                    if bucket is not None and b != bucket:
-                        continue  # different compile bucket: scan on
-                    pages = self._alloc_pages(
-                        seq.pages_needed(self.page_size, headroom=1))
-                    if pages is None:
-                        if seq is head and head_aged:
-                            break  # aged head waits for memory first
-                        continue
-                    # the group's bucket is claimed by the first prompt
-                    # that actually ADMITS (a memory-blocked prompt must
-                    # not poison the bucket for the rest of the scan)
-                    bucket = b
-                    slot = free_slots.pop(0)
-                    self.waiting.remove(seq)
-                    group.append((seq, slot, pages))
-                    spent += len(seq.prompt)
-                else:
-                    # ---- chunked path: slot + pages now, KV in chunks
-                    need = seq.pages_needed(self.page_size, headroom=1) \
-                        - len(matched_pages) + (1 if cow else 0)
-                    tail_pages = self._alloc_pages(need)
-                    if tail_pages is None:
-                        self._unmatch(matched_pages)
-                        if seq is head and head_aged:
-                            break
-                        continue
-                    slot = free_slots.pop(0)
-                    self.waiting.remove(seq)
-                    seq.slot = slot
-                    seq.prefilling = True
-                    seq.num_computed = matched
-                    seq.cached_tokens = matched
-                    self._slots[slot] = seq
-                    chunked.append((seq, matched_pages, tail_pages, cow))
-        for seq, matched_pages, tail_pages, cow in chunked:
+                need = seq.pages_needed(self.page_size, headroom=1) \
+                    - len(matched_pages) + (1 if cow else 0)
+                tail_pages = self._alloc_pages(need)
+                if tail_pages is None:
+                    self._unmatch(matched_pages)
+                    if seq is head and head_aged:
+                        break  # aged head waits for memory first
+                    continue
+                slot = free_slots.pop(0)
+                self.waiting.remove(seq)
+                seq.slot = slot
+                seq.prefilling = True
+                seq.num_computed = matched
+                seq.cached_tokens = matched
+                self._slots[slot] = seq
+                admitted.append((seq, matched_pages, tail_pages, cow))
+        for seq, matched_pages, tail_pages, cow in admitted:
             if cow:
                 # tail writes land inside the last shared page: copy it
                 # on device, then drop our reference to the original
                 cow_page = tail_pages.pop(0)
                 orig = matched_pages[-1]
-                self.k_cache, self.v_cache = self._fns.copy_page(
-                    self.k_cache, self.v_cache, jnp.int32(orig),
-                    jnp.int32(cow_page))
+                self.kv = self._fns.copy_page(self.kv, jnp.int32(orig),
+                                              jnp.int32(cow_page))
                 self._release_pages([orig])
                 matched_pages = matched_pages[:-1] + [cow_page]
                 self.stats["cow_copies"] += 1
@@ -446,92 +368,117 @@ class InferenceEngine:
             self.stats["cached_tokens"] += seq.cached_tokens
             self._note_cached(seq.request_id, seq.cached_tokens)
             self._chunking.append(seq)
-        if not group:
-            return spent
-        Tpad = _bucket(max(len(s.prompt) for s, _, _ in group))
-        self.stats["prefill_dispatches"] += 1
-        for seq, _, _ in group:
-            self.stats["prefill_tokens"] += len(seq.prompt)
-        if len(group) == 1:
-            seq, slot, pages = group[0]
-            T = len(seq.prompt)
-            tokens = np.zeros((1, Tpad), np.int32)
-            tokens[0, :T] = seq.prompt
-            tok, k_all, v_all = self._fns.prefill_tok(
-                self.params, jnp.asarray(tokens), jnp.int32(T))
-            self._postfill(seq, slot, pages, int(tok), k_all, v_all)
-            return spent
-        # batched path: pad the group to a power-of-two size so compile
-        # count stays |size buckets| x |length buckets|, not one program
-        # per exact group size
-        N = len(group)
-        Npad = _bucket(N, lo=1)
-        tokens = np.zeros((Npad, Tpad), np.int32)
-        lens = np.ones(Npad, np.int32)
-        for i, (seq, _, _) in enumerate(group):
-            tokens[i, :len(seq.prompt)] = seq.prompt
-            lens[i] = len(seq.prompt)
-        toks_n, k_n, v_n = self._fns.prefill_many_tok(
-            self.params, jnp.asarray(tokens), jnp.asarray(lens))
-        # ONE blocking readback for the whole group's first tokens (argmax
-        # fused into the prefill program), then ONE fused scatter writes
-        # every sequence's prompt KV into its pages — 2N per-sequence
-        # write dispatches collapsed to 2, which on a remote/tunneled
-        # device takes ~100ms of host dispatch latency off the NEXT
-        # group's first token
-        first_toks = np.asarray(toks_n)
-        n_pages_max = max(len(p) for _, _, p in group)
-        t_page = n_pages_max * self.page_size
-        pages_n = np.full((Npad, n_pages_max), SCRATCH_PAGE, np.int32)
-        wlens = np.zeros(Npad, np.int32)  # pad rows: 0 -> all-zero write
-        for i, (seq, _, pages) in enumerate(group):
-            pages_n[i, :len(pages)] = pages
-            wlens[i] = len(seq.prompt)
-        self.k_cache, self.v_cache = self._fns.write_prefill_pages_group(
-            self.k_cache, self.v_cache, k_n, v_n, jnp.asarray(wlens),
-            jnp.asarray(pages_n), t_page)
-        for i, (seq, slot, pages) in enumerate(group):
-            self._postfill_book(seq, slot, pages, int(first_toks[i]))
-        return spent
 
-    def _run_chunk(self, seq: SequenceState, allowance: int) -> int:
-        """Compute the next prefill chunk (at most prefill_chunk /
-        allowance tokens) for one chunked sequence; on the final chunk
-        the fused argmax's token joins it to the decode batch. Returns
-        tokens computed."""
-        remaining = len(seq.prompt) - seq.num_computed
-        C = min(self.prefill_chunk, remaining, allowance)
-        if C <= 0:
-            return 0
-        Cpad = _bucket(C)
-        tokens = np.zeros((1, Cpad), np.int32)
-        tokens[0, :C] = seq.prompt[seq.num_computed:seq.num_computed + C]
-        row = np.full(self.max_pages_per_seq, SCRATCH_PAGE, np.int32)
-        row[:len(seq.pages)] = seq.pages
-        tok, self.k_cache, self.v_cache = self._fns.prefill_chunk_tok(
-            self.params, jnp.asarray(tokens), jnp.asarray(row),
-            jnp.int32(seq.num_computed), jnp.int32(C),
-            self.k_cache, self.v_cache)
-        seq.num_computed += C
-        self.stats["prefill_tokens"] += C
-        self.stats["chunk_dispatches"] += 1
-        if seq.num_computed >= len(seq.prompt):
-            self._chunking.remove(seq)
-            seq.prefilling = False
-            self._postfill_book(seq, seq.slot, seq.pages, int(tok))
-        return C
+    # --------------------------------------------------- ragged mixed step
 
-    def _postfill(self, seq: SequenceState, slot: int, pages: List[int],
-                  first_tok: int, k_all, v_all) -> None:
-        """Single-prompt path: write the prompt K/V into its pages (async
-        dispatch), then the shared bookkeeping."""
-        T = len(seq.prompt)
-        Tpage = len(pages) * self.page_size
-        pages_arr = jnp.asarray(pages, jnp.int32)
-        self.k_cache, self.v_cache = self._fns.write_prefill_pages(
-            self.k_cache, self.v_cache, k_all, v_all, jnp.int32(T),
-            pages_arr, Tpage)
-        self._postfill_book(seq, slot, pages, first_tok)
+    def _ragged_dispatch(self, finished: Dict[str, List[int]]) -> bool:
+        """Assemble and run ONE ragged mixed step, if prefill work is
+        pending: decode rows first (slot r owns ragged token r), then up
+        to prefill_rows chunk rows packed from token max_batch on, FIFO
+        over the chunking queue under the step token budget. Rows whose
+        chunk finishes its prompt get their first sampled token from the
+        SAME dispatch (fused argmax) — no extra program, no extra
+        readback. Returns False (no dispatch) when no chunk work exists,
+        sending the step to the pure-decode loop instead."""
+        budget = self.step_token_budget \
+            if self.step_token_budget > 0 else (1 << 30)
+        rows: List[Tuple[SequenceState, int]] = []
+        for seq in self._chunking:
+            if len(rows) >= self.prefill_rows:
+                break
+            C = min(self.prefill_chunk,
+                    len(seq.prompt) - seq.num_computed, budget)
+            if C <= 0:
+                break  # step token budget exhausted
+            rows.append((seq, C))
+            budget -= C
+        if not rows:
+            return False
+        # decode rows advance one token: they need a page for it
+        for slot, seq in list(enumerate(self._slots)):
+            if seq is not None and not seq.prefilling:
+                self._ensure_pages(slot, seq, 1, finished)
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None and not s.prefilling]
+        ps = self.page_size
+        Tcap, R = self.ragged_tokens, self.ragged_rows
+        tokens = np.zeros(Tcap, np.int32)
+        token_pos = np.zeros(Tcap, np.int32)
+        token_page = np.full(Tcap, SCRATCH_PAGE, np.int32)
+        token_slot = np.zeros(Tcap, np.int32)
+        q_start = np.zeros(R, np.int32)
+        q_len = np.zeros(R, np.int32)
+        kv_len = np.zeros(R, np.int32)
+        ptab = np.full((R, self.max_pages_per_seq), SCRATCH_PAGE,
+                       np.int32)
+        q_start[:self.max_batch] = np.arange(self.max_batch,
+                                             dtype=np.int32)
+        ptab[:self.max_batch] = self._page_table
+        for i, s in active:
+            pos = int(self._positions[i])
+            tokens[i] = self._tokens[i]
+            token_pos[i] = pos
+            token_page[i] = self._page_table[i, pos // ps]
+            token_slot[i] = pos % ps
+            q_len[i] = 1
+            kv_len[i] = s.num_tokens
+        t0 = self.max_batch
+        for j, (seq, C) in enumerate(rows):
+            r = self.max_batch + j
+            start = seq.num_computed
+            pos = np.arange(start, start + C, dtype=np.int32)
+            tokens[t0:t0 + C] = seq.prompt[start:start + C]
+            token_pos[t0:t0 + C] = pos
+            pages = np.asarray(seq.pages, np.int32)
+            token_page[t0:t0 + C] = pages[pos // ps]
+            token_slot[t0:t0 + C] = pos % ps
+            ptab[r, :len(seq.pages)] = pages
+            q_start[r] = t0
+            q_len[r] = C
+            kv_len[r] = start + C
+            t0 += C
+        nxt, self.kv = self._fns.ragged_step(
+            self.params, jnp.asarray(tokens), jnp.asarray(token_pos),
+            jnp.asarray(token_page), jnp.asarray(token_slot),
+            jnp.asarray(ptab), jnp.asarray(q_start), jnp.asarray(q_len),
+            jnp.asarray(kv_len), self.kv)
+        nxt = np.asarray(nxt)                      # [R], ONE readback
+        chunk_tokens = sum(C for _, C in rows)
+        self.stats["ragged_dispatches"] += 1
+        self.stats["ragged_real_tokens"] += len(active) + chunk_tokens
+        self.stats["ragged_slot_tokens"] += Tcap
+        self.stats["prefill_tokens"] += chunk_tokens
+        if active:
+            self.stats["decode_steps"] += 1
+            self.stats["decode_tokens"] += len(active)
+        for slot, seq in active:
+            tok = int(nxt[slot])
+            if self.eos_token is not None and tok == self.eos_token:
+                self._note_finish(seq.request_id, "stop")
+                self._finish(slot, seq, finished)
+                continue
+            seq.generated.append(tok)
+            if self.track_progress:
+                self._progress.setdefault(seq.request_id, []).append(tok)
+            if len(seq.generated) >= seq.max_new_tokens:
+                self._finish(slot, seq, finished)
+                continue
+            self._tokens[slot] = tok
+            self._positions[slot] = seq.num_tokens - 1
+        for j, (seq, C) in enumerate(rows):
+            seq.num_computed += C
+            if seq.num_computed >= len(seq.prompt):
+                self._chunking.remove(seq)
+                seq.prefilling = False
+                self._postfill_book(seq, seq.slot, seq.pages,
+                                    int(nxt[self.max_batch + j]))
+                if not seq.done:
+                    # entering the decode batch: reserve the decode-loop
+                    # headroom NOW, before next step's admission scan can
+                    # hand these pages to a younger request
+                    self._ensure_pages(seq.slot, seq,
+                                       self.decode_chunk, finished)
+        return True
 
     def _postfill_book(self, seq: SequenceState, slot: int,
                        pages: List[int], first_tok: int) -> None:
@@ -560,7 +507,7 @@ class InferenceEngine:
             self._note_finish(seq.request_id,
                               "stop" if not out else "length")
             self._release_pages(pages)
-            if seq.slot is not None:    # chunked path reserved a slot
+            if seq.slot is not None:
                 self._slots[seq.slot] = None
                 self._page_table[seq.slot, :] = SCRATCH_PAGE
                 seq.slot = None
@@ -589,13 +536,12 @@ class InferenceEngine:
         with self._lock:
             self.running.remove(seq)
 
-    def _ensure_chunk_pages(self, slot: int, seq: SequenceState,
-                            finished: Dict[str, List[int]]) -> bool:
-        """Pages for num_tokens + decode_chunk (the chunk may overshoot
+    def _ensure_pages(self, slot: int, seq: SequenceState, headroom: int,
+                      finished: Dict[str, List[int]]) -> bool:
+        """Pages for num_tokens + headroom (a decode block may overshoot
         past EOS/max_new_tokens into the sequence's own pages). False =
         evicted for lack of cache memory."""
-        need = min(seq.pages_needed(self.page_size,
-                                    headroom=self.decode_chunk),
+        need = min(seq.pages_needed(self.page_size, headroom=headroom),
                    self.max_pages_per_seq)
         while len(seq.pages) < need:
             extra = self._alloc_pages(1)
@@ -608,27 +554,23 @@ class InferenceEngine:
             seq.pages.extend(extra)
         return True
 
-    def _decode(self) -> Dict[str, List[int]]:
-        finished: Dict[str, List[int]] = {}
+    # ----------------------------------------------------- pure decode
+
+    def _decode(self, finished: Dict[str, List[int]]) -> None:
         for slot, seq in list(enumerate(self._slots)):
             if seq is not None and not seq.prefilling:
-                self._ensure_chunk_pages(slot, seq, finished)
-        # chunk-prefilling sequences hold slots but stay out of the
-        # decode batch; their host page_table rows remain SCRATCH until
-        # they join, so the fixed-shape decode step cannot touch their
-        # pages
+                self._ensure_pages(slot, seq, self.decode_chunk, finished)
         active = [(i, s) for i, s in enumerate(self._slots)
                   if s is not None and not s.prefilling]
         if not active:
-            return finished
+            return
         K = self.decode_chunk
         seq_lens = np.ones(self.max_batch, np.int32)
         for i, s in active:
             seq_lens[i] = s.num_tokens
-        toks_out, self.k_cache, self.v_cache, _, _ = self._fns.decode_loop(
+        toks_out, self.kv, _, _ = self._fns.decode_loop(
             self.params, jnp.asarray(self._tokens),
-            jnp.asarray(self._positions),
-            self.k_cache, self.v_cache,
+            jnp.asarray(self._positions), self.kv,
             jnp.asarray(self._page_table), jnp.asarray(seq_lens))
         block = np.asarray(toks_out)               # [K, B], ONE readback
         self.stats["decode_steps"] += K
@@ -651,7 +593,6 @@ class InferenceEngine:
             else:
                 self._tokens[slot] = int(block[K - 1, slot])
                 self._positions[slot] = seq.num_tokens - 1
-        return finished
 
     def drain_progress(self) -> Dict[str, List[int]]:
         """Tokens generated since the previous drain, per request id
@@ -689,18 +630,33 @@ class InferenceEngine:
         dt = now - self._metrics_ts
         if dt < 1.0 and not force:
             return
-        pf, dc = self.stats["prefill_tokens"], self.stats["decode_tokens"]
-        lp, ld = self._metrics_last
-        self._metrics_last = (pf, dc)
+        s, last = self.stats, self._metrics_last
+        self._metrics_last = dict(s)
         self._metrics_ts = now
         allocatable = self.allocator.total_pages - 1   # page 0 = scratch
         self._g_kv_util.set(1.0 - self.allocator.num_free / allocatable)
-        cached = self.stats["cached_tokens"]
-        denom = cached + pf
+        cached = s["cached_tokens"]
+        denom = cached + s["prefill_tokens"]
         self._g_hit_rate.set(cached / denom if denom else 0.0)
         if dt > 0:
-            self._g_prefill_tps.set((pf - lp) / dt)
-            self._g_decode_tps.set((dc - ld) / dt)
+            self._g_prefill_tps.set(
+                (s["prefill_tokens"] - last["prefill_tokens"]) / dt)
+            self._g_decode_tps.set(
+                (s["decode_tokens"] - last["decode_tokens"]) / dt)
+        # ragged-step visibility: resident compiled programs (O(1) by
+        # design), device dispatches per scheduler step, and the padding
+        # fraction of ragged token slots over the gauge window
+        self._g_programs.set(float(self.compiled_step_programs()))
+        d_steps = s["steps"] - last["steps"]
+        if d_steps > 0:
+            disp = sum(s[k] - last[k] for k in
+                       ("ragged_dispatches", "decode_dispatches",
+                        "cow_copies"))
+            self._g_dispatches.set(disp / d_steps)
+        d_slots = s["ragged_slot_tokens"] - last["ragged_slot_tokens"]
+        if d_slots > 0:
+            d_real = s["ragged_real_tokens"] - last["ragged_real_tokens"]
+            self._g_pad_waste.set(1.0 - d_real / d_slots)
         with self._lock:
             self._g_queue.set(len(self.waiting))
 

@@ -3,24 +3,30 @@
 Role-equivalent to the reference's vLLM model executor (reference:
 llm/_internal/serve/deployments/llm/vllm/ — the reference ships no model
 code in-tree), rebuilt on ray_tpu's functional Llama (models/llama.py —
-same params pytree, so training checkpoints serve directly):
+same params pytree, so training checkpoints serve directly).
 
-  - ``prefill``: full-prompt forward that RETURNS the per-layer K/V it
-    computed (to be written into the page pool) plus last-position logits;
-  - ``decode_step``: one token per sequence against the paged KV cache —
-    writes the new token's K/V into its page, then paged attention.
+ONE step program for everything (`_ragged_step_body`): the engine packs
+decode tokens and prefill-chunk tokens into a single RAGGED batch
+(`ops.paged_attention.ragged_paged_attention`), so prefill chunks and
+decode steps share one compiled program instead of a per-length-bucket
+zoo. Per layer the step writes every ragged token's K/V into the paged
+pool (`write_ragged_kv` — quantizing when the pool is int8) and then
+attends; per row the last valid token's logits argmax fuses in-program,
+so a finishing prefill chunk's first token and every decode row's next
+token come back in ONE readback.
 
-Both are single jit programs: layers are stacked and scanned, the cache
-is a [n_layers, ...] leaf threaded through the scan.
+The KV pool is a dict pytree {"k", "v"[, "k_scale", "v_scale"]} —
+layers stacked on the leading axis and threaded through the layer scan
+as scan xs/ys with jit donation (the decode-path discipline PR 3
+measured at ~4 ms/step vs 140 ms/step undonated).
 
-Tensor parallelism (``tp_axis``): every function here also runs INSIDE a
+Tensor parallelism (``tp_axis``): the step also runs INSIDE a
 ``shard_map`` block whose weights arrive pre-sliced Megatron-style
-(wq/wk/wv/w_gate/w_up column-sharded, wo/w_down row-sharded — the
-reference expresses the same degrees as vLLM engine_kwargs,
-vllm_models.py:129). Head counts are derived from the LOCAL weight
-shapes, attention runs on the local head shard with zero communication,
-and the two row-parallel projections psum over ``tp_axis`` — two
-collectives per layer, the textbook Megatron schedule, riding ICI.
+(wq/wk/wv/w_gate/w_up column-sharded, wo/w_down row-sharded). Head
+counts derive from the LOCAL weight shapes, attention runs on the local
+kv-head shard of the pool with zero communication, and the two
+row-parallel projections psum over ``tp_axis`` — two collectives per
+layer, the textbook Megatron schedule, riding ICI.
 """
 
 from __future__ import annotations
@@ -33,7 +39,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from ray_tpu.models.llama import LlamaConfig, Params, _rmsnorm, _rope
-from ray_tpu.ops.paged_attention import paged_attention, write_decode_kv
+from ray_tpu.ops.paged_attention import (ragged_paged_attention,
+                                         write_ragged_kv)
+
+KVCache = dict  # {"k", "v"[, "k_scale", "v_scale"]}, leading axis layers
 
 
 def _maybe_psum(x, tp_axis):
@@ -65,304 +74,138 @@ def _mlp(lp, x, cfg: LlamaConfig, tp_axis=None):
     return x + _maybe_psum((gate * up) @ lp["w_down"].astype(cd), tp_axis)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "tp_axis"))
-def prefill(params: Params, tokens: jax.Array, true_len: jax.Array,
-            cfg: LlamaConfig, tp_axis: Optional[str] = None,
-            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """tokens [1, T] (T may be padded) → (logits [vocab], k_all, v_all).
+def _ragged_step_body(params: Params, tokens: jax.Array,
+                      token_pos: jax.Array, token_page: jax.Array,
+                      token_slot: jax.Array, page_table: jax.Array,
+                      q_start: jax.Array, q_len: jax.Array,
+                      kv_len: jax.Array, kv: KVCache, cfg: LlamaConfig,
+                      tp_axis: Optional[str] = None,
+                      paged_impl: Optional[str] = None,
+                      max_q_len: Optional[int] = None,
+                      decode_rows: int = 0,
+                      ) -> Tuple[jax.Array, KVCache]:
+    """ONE forward over a ragged mixed prefill+decode batch.
 
-    ``true_len`` is the unpadded prompt length: logits come from position
-    true_len-1 (padding sits AFTER the real tokens, and causality means
-    padded positions never contaminate real ones — they only ever attend
-    backwards). k_all/v_all: [n_layers, T, Hkv, D] — the prompt's cache
-    entries in sequence order, ready for write_prefill_kv (caller slices
-    to true_len). Causal full attention: prompts are short relative to
-    training, and the blockwise fallback covers CPU.
+    tokens/token_pos: [T] the ragged token ids and absolute positions;
+    token_page/token_slot: [T] each token's destination in the page pool
+    (padding tokens -> the scratch page); page_table [R, max_pages] +
+    q_start/q_len/kv_len [R]: the per-row ragged descriptors
+    (ops.paged_attention). kv: the pool dict — DONATED by every caller
+    (an undonated pool copies multi-GB per step).
 
-    Under ``tp_axis``, k_all/v_all hold the LOCAL kv-head shard and
-    logits are replicated (psum'd) — attention itself needs no
-    communication because heads are independent.
+    Returns (next_tok [R], kv): per row, argmax logits at its LAST valid
+    token — the next decode token for q_len==1 rows, the first sampled
+    token for a prefill chunk that just finished its prompt. Fused
+    in-program so the whole mixed step is ONE dispatch + ONE readback.
+
+    Per layer: project/rope the ragged tokens, scatter their K/V into
+    the pool (quantizing to int8 + scales when the pool carries scale
+    leaves), then ragged attention over the pool — each token causally
+    sees its row's pages up to its own position, so a chunk's tokens see
+    the prefix AND earlier tokens of the same chunk (just written).
     """
-    B, T = tokens.shape
+    T = tokens.shape[0]
     cd = cfg.dtype
-    x = params["embed"].astype(cd)[tokens]
-    positions = jnp.arange(T)
-
-    def layer(x, lp):
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(lp, h, cfg)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        kr, vr = k, v
-        if k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            kr = jnp.repeat(k, rep, axis=2)
-            vr = jnp.repeat(v, rep, axis=2)
-        from ray_tpu.parallel.attention import attention
-        o = attention(q, kr, vr, causal=True)
-        o = o.reshape(B, T, -1).astype(cd)
-        x = x + _maybe_psum(o @ lp["wo"].astype(cd), tp_axis)
-        x = _mlp(lp, x, cfg, tp_axis)
-        return x, (k[0], v[0])  # [T, Hkv(_local), D] per layer
-
-    x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    xlast = lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0,
-                                     keepdims=False)
-    logits = jnp.einsum("d,vd->v", xlast.astype(cd),
-                        params["embed"].astype(cd),
-                        preferred_element_type=jnp.float32)
-    return logits, k_all, v_all
-
-
-@functools.partial(jax.jit, static_argnames=("cfg", "tp_axis"))
-def prefill_many(params: Params, tokens: jax.Array, true_lens: jax.Array,
-                 cfg: LlamaConfig, tp_axis: Optional[str] = None,
-                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Batched prefill: tokens [N, Tpad], true_lens [N] →
-    (logits [N, vocab], k_all [N, n_layers, Tpad, Hkv, D], v_all same).
-
-    vmap over the single-prompt program: N queued prompts (padded to one
-    shared length bucket) ride ONE device dispatch instead of N — under
-    admission queues this is the difference between TTFT growing with
-    queue depth and amortizing it (reference: vLLM batched prefill
-    scheduling in the engine step)."""
-    def one(tok_row, tl):
-        return prefill(params, tok_row[None, :], tl, cfg, tp_axis)
-    return jax.vmap(one, in_axes=(0, 0))(tokens, true_lens)
-
-
-def _decode_body(params: Params, tokens: jax.Array, positions: jax.Array,
-                 k_cache: jax.Array, v_cache: jax.Array,
-                 page_table: jax.Array, seq_lens: jax.Array,
-                 cfg: LlamaConfig, tp_axis: Optional[str] = None,
-                 paged_impl: Optional[str] = None,
-                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decode step for the whole running batch.
-
-    tokens [B] int32, positions [B] (0-based slot of THIS token),
-    k/v_cache [n_layers, P, Hkv, ps, D], page_table [B, max_pages],
-    seq_lens [B] (valid tokens INCLUDING this one, i.e. positions+1).
-    Returns (logits [B, vocab], new_k_cache, new_v_cache).
-
-    The caches are DONATED: without donation every step would copy the
-    multi-GB pools to apply a one-token scatter (measured 140 ms/step on
-    a 202M model vs ~4 ms with donation). Callers must treat the passed
-    cache arrays as consumed.
-    """
-    B = tokens.shape[0]
-    cd = cfg.dtype
-    x = params["embed"].astype(cd)[tokens][:, None, :]   # [B, 1, d]
+    x = params["embed"].astype(cd)[tokens][None]          # [1, T, d]
+    quantized = "k_scale" in kv
 
     def layer(x, inp):
-        lp, kc, vc = inp
+        lp, kv_l = inp
         h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(lp, h, cfg)               # [B,1,H,D]
-        q = _rope(q, positions[:, None], cfg.rope_theta)
-        k = _rope(k, positions[:, None], cfg.rope_theta)
-        kc, vc = write_decode_kv(kc, vc, k[:, 0], v[:, 0],
-                                 page_table, positions)
-        o = paged_attention(q[:, 0], kc, vc, page_table, seq_lens,
-                            impl=paged_impl)
-        o = o.reshape(B, 1, -1).astype(cd)
+        q, k, v = _project_qkv(lp, h, cfg)                # [1, T, H, D]
+        q = _rope(q, token_pos, cfg.rope_theta)
+        k = _rope(k, token_pos, cfg.rope_theta)
+        kc, vc, ksc, vsc = write_ragged_kv(
+            kv_l["k"], kv_l["v"], k[0], v[0], token_page, token_slot,
+            kv_l.get("k_scale"), kv_l.get("v_scale"))
+        o = ragged_paged_attention(
+            q[0], kc, vc, page_table, q_start, q_len, kv_len,
+            k_scale=ksc, v_scale=vsc, max_q_len=max_q_len,
+            decode_rows=decode_rows, impl=paged_impl)
+        o = o.reshape(1, T, -1).astype(cd)
         x = x + _maybe_psum(o @ lp["wo"].astype(cd), tp_axis)
         x = _mlp(lp, x, cfg, tp_axis)
-        return x, (kc, vc)
+        kv_out = {"k": kc, "v": vc}
+        if quantized:
+            kv_out["k_scale"], kv_out["v_scale"] = ksc, vsc
+        return x, kv_out
 
-    x, (k_cache, v_cache) = lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache))
+    x, kv = lax.scan(layer, x, (params["layers"], kv))
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bd,vd->bv", x[:, 0].astype(cd),
+    last = jnp.clip(q_start + q_len - 1, 0, T - 1)        # [R]
+    xl = x[0][last]
+    logits = jnp.einsum("rd,vd->rv", xl.astype(cd),
                         params["embed"].astype(cd),
                         preferred_element_type=jnp.float32)
-    return logits, k_cache, v_cache
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
 
 
-def _prefill_chunk_body(params: Params, tokens: jax.Array,
-                        pages: jax.Array, prior_len: jax.Array,
-                        valid_len: jax.Array, k_cache: jax.Array,
-                        v_cache: jax.Array, cfg: LlamaConfig,
+def _ragged_decode_loop(params: Params, tokens: jax.Array,
+                        positions: jax.Array, kv: KVCache,
+                        page_table: jax.Array, seq_lens: jax.Array,
+                        num_steps: int, cfg: LlamaConfig,
                         tp_axis: Optional[str] = None,
-                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One CHUNK of a prompt, attending to the prior paged KV.
-
-    tokens [1, Cpad] (chunk padded to its length bucket); pages
-    [max_pages] the sequence's page row (scratch-padded); prior_len:
-    tokens already resident in the pages (prefix-cache hits + earlier
-    chunks); valid_len: real tokens in this chunk. Returns (next_tok,
-    k_cache, v_cache): argmax logits at the chunk's last valid position,
-    fused in-program like _prefill_tok so a final chunk's first token is
-    one scalar readback.
-
-    The pool is touched exactly twice, OUTSIDE the layer scan: one
-    gather of this sequence's page rows before it, one write_chunk_kv
-    scatter of every layer's chunk K/V after it. Inside the scan,
-    attention sees the gathered prior (positions < prior_len) plus the
-    chunk's in-flight K/V, same as `prefill` never touching the pool
-    mid-program. Threading the pool through the scan as carries/ys
-    instead makes XLA stack full-pool copies per layer — measured
-    pool-size-proportional, ~7x a whole 128-token prefill.
-
-    This is the chunked-prefill workhorse: a 2k-token prompt becomes
-    several bounded dispatches interleaved with decode steps instead of
-    one monolithic prefill stalling the running batch.
-    """
-    from ray_tpu.ops.paged_attention import (paged_chunk_attention,
-                                             write_chunk_kv)
-    B, C = tokens.shape
-    cd = cfg.dtype
-    x = params["embed"].astype(cd)[tokens]          # [1, C, d]
-    positions = prior_len + jnp.arange(C)
-    k_prior = k_cache[:, pages]                     # [L, n, Hkv, ps, D]
-    v_prior = v_cache[:, pages]
-
-    def layer(x, inp):
-        lp, kp, vp = inp
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(lp, h, cfg)          # [1, C, H(_local), D]
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        o = paged_chunk_attention(q[0], kp, vp, k[0], v[0], prior_len)
-        o = o.reshape(B, C, -1).astype(cd)
-        x = x + _maybe_psum(o @ lp["wo"].astype(cd), tp_axis)
-        x = _mlp(lp, x, cfg, tp_axis)
-        return x, (k[0], v[0])
-
-    x, (k_all, v_all) = lax.scan(
-        layer, x, (params["layers"], k_prior, v_prior))
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    xlast = lax.dynamic_index_in_dim(x[0], valid_len - 1, axis=0,
-                                     keepdims=False)
-    logits = jnp.einsum("d,vd->v", xlast.astype(cd),
-                        params["embed"].astype(cd),
-                        preferred_element_type=jnp.float32)
-    k_cache, v_cache = write_chunk_kv(k_cache, v_cache, k_all, v_all,
-                                      pages, prior_len, valid_len)
-    return jnp.argmax(logits), k_cache, v_cache
-
-
-#: single-chip jit of the chunk program (compiles once per chunk bucket)
-prefill_chunk_tok = functools.partial(
-    jax.jit, static_argnames=("cfg", "tp_axis"),
-    donate_argnames=("k_cache", "v_cache"))(_prefill_chunk_body)
-
-
-def _copy_page_body(k_cache, v_cache, src, dst):
-    """Copy-on-write: duplicate one page's K/V across all layers (a
-    prefix-hit sequence about to write into a shared page copies it
-    first). Plain body so tp.py can shard_map it over local head shards."""
-    k_cache = k_cache.at[:, dst].set(
-        lax.dynamic_index_in_dim(k_cache, src, axis=1, keepdims=False))
-    v_cache = v_cache.at[:, dst].set(
-        lax.dynamic_index_in_dim(v_cache, src, axis=1, keepdims=False))
-    return k_cache, v_cache
-
-
-copy_page = functools.partial(
-    jax.jit, donate_argnames=("k_cache", "v_cache"))(_copy_page_body)
-
-
-def stage_prefill_kv(k_cache, v_cache, k_all, v_all, true_len, pages,
-                     t_page: int):
-    """Zero padding positions, pad/slice to t_page tokens, scatter the
-    prompt's K/V into its pages — fully on device (shared by the
-    single-chip jit in engine.py and the tp shard_map in tp.py; under tp
-    every array carries the LOCAL kv-head shard and the scatter needs no
-    communication)."""
-    from ray_tpu.ops.paged_attention import write_prefill_kv
-    Tpad = k_all.shape[1]
-    mask = (jnp.arange(Tpad) < true_len)[None, :, None, None]
-    k_all = jnp.where(mask, k_all, 0)
-    v_all = jnp.where(mask, v_all, 0)
-    if t_page <= Tpad:
-        k_all, v_all = k_all[:, :t_page], v_all[:, :t_page]
-    else:
-        pad = [(0, 0), (0, t_page - Tpad), (0, 0), (0, 0)]
-        k_all, v_all = jnp.pad(k_all, pad), jnp.pad(v_all, pad)
-    return jax.vmap(write_prefill_kv, in_axes=(0, 0, 0, 0, None))(
-        k_cache, v_cache, k_all, v_all, pages)
-
-
-def stage_prefill_kv_group(k_cache, v_cache, k_n, v_n, true_lens,
-                           pages_n, t_page: int):
-    """Whole-GROUP prefill-KV scatter in one program.
-
-    k_n/v_n: [N, L, Tpad, Hkv, D] from prefill_many; true_lens: [N];
-    pages_n: [N, n_pages] page ids, rows padded with SCRATCH_PAGE where a
-    sequence needs fewer pages (the padding positions are zero-masked, so
-    the scratch page only ever receives zeros — it is garbage by
-    contract). All N sequences' pages flatten into ONE scatter per cache:
-    on a tunneled/remote device each dispatch costs real host latency, so
-    2 dispatches instead of 2N is a direct queued-TTFT win (measured:
-    ~100ms off an 8-prompt group's first token)."""
-    N, L, Tpad = k_n.shape[:3]
-    mask = (jnp.arange(Tpad)[None, :] <
-            true_lens[:, None])[:, None, :, None, None]
-    k_n = jnp.where(mask, k_n, 0)
-    v_n = jnp.where(mask, v_n, 0)
-    if t_page <= Tpad:
-        k_n, v_n = k_n[:, :, :t_page], v_n[:, :, :t_page]
-    else:
-        pad = [(0, 0), (0, 0), (0, t_page - Tpad), (0, 0), (0, 0)]
-        k_n, v_n = jnp.pad(k_n, pad), jnp.pad(v_n, pad)
-    ps = k_cache.shape[3]
-    n_pages = t_page // ps
-
-    def to_pages(x):   # [N, L, t_page, H, D] -> [L, N*n_pages, H, ps, D]
-        N_, L_, _, H, D = x.shape
-        x = x.reshape(N_, L_, n_pages, ps, H, D)
-        x = x.transpose(1, 0, 2, 4, 3, 5)
-        return x.reshape(L_, N_ * n_pages, H, ps, D)
-
-    pages_flat = pages_n.reshape(-1)
-    k_cache = k_cache.at[:, pages_flat].set(
-        to_pages(k_n).astype(k_cache.dtype))
-    v_cache = v_cache.at[:, pages_flat].set(
-        to_pages(v_n).astype(v_cache.dtype))
-    return k_cache, v_cache
-
-
-#: single-step variant (tests, chunk=1 engines)
-decode_step = functools.partial(jax.jit,
-                                static_argnames=("cfg", "tp_axis",
-                                                 "paged_impl"),
-                                donate_argnames=("k_cache", "v_cache"),
-                                )(_decode_body)
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("num_steps", "cfg", "tp_axis",
-                                    "paged_impl"),
-                   donate_argnames=("k_cache", "v_cache"))
-def decode_loop(params: Params, tokens: jax.Array, positions: jax.Array,
-                k_cache: jax.Array, v_cache: jax.Array,
-                page_table: jax.Array, seq_lens: jax.Array,
-                num_steps: int, cfg: LlamaConfig,
-                tp_axis: Optional[str] = None,
-                paged_impl: Optional[str] = None):
+                        paged_impl: Optional[str] = None):
     """``num_steps`` greedy decode steps in ONE device program.
 
-    Multi-step scheduling: each host↔device round-trip costs real latency
-    (PCIe normally; a network tunnel here), so the engine amortizes it by
-    sampling on-device and reading back a [num_steps, B] token block per
-    dispatch instead of one [B] row per step. Sequences that hit EOS
-    mid-block keep decoding garbage into their own pages; the host
-    truncates on readback (bounded overshoot, the reference's vLLM
-    multi-step trade-off).
+    The pure-decode fast path: every batch slot is one ragged decode row
+    (q_start = slot index, q_len = 1), so this is the ragged step
+    degenerated to T == R == max_batch, scanned num_steps times with
+    on-device sampling and a single [num_steps, B] readback (each
+    host<->device round-trip costs real latency — PCIe normally, a
+    network tunnel here — so K steps ride one trip, vLLM multi-step
+    scheduling). Sequences that hit EOS mid-block keep decoding garbage
+    into their OWN pages; the host truncates on readback.
 
-    Returns (tokens_out [num_steps, B], k_cache, v_cache,
-    final_positions, final_seq_lens) — positions/seq_lens advance by
-    num_steps so the next block chains without host recomputation.
+    Returns (tokens_out [num_steps, B], kv, final_positions,
+    final_seq_lens) — positions/seq_lens advance by num_steps so the
+    next block chains without host recomputation.
     """
-    def one(carry, _):
-        tokens, positions, kc, vc, seq_lens = carry
-        logits, kc, vc = _decode_body(params, tokens, positions, kc, vc,
-                                      page_table, seq_lens, cfg, tp_axis,
-                                      paged_impl)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (nxt, positions + 1, kc, vc, seq_lens + 1), nxt
+    R = tokens.shape[0]
+    ps = kv["k"].shape[3]
+    max_pages = page_table.shape[1]
+    ar = jnp.arange(R, dtype=jnp.int32)
+    ones = jnp.ones(R, jnp.int32)
 
-    (tok, positions, k_cache, v_cache, seq_lens), toks_out = lax.scan(
-        one, (tokens, positions, k_cache, v_cache, seq_lens),
-        None, length=num_steps)
-    return toks_out, k_cache, v_cache, positions, seq_lens
+    def one(carry, _):
+        tok, pos, kv, lens = carry
+        page_idx = jnp.clip(pos // ps, 0, max_pages - 1)
+        token_page = page_table[ar, page_idx]
+        token_slot = pos % ps
+        nxt, kv = _ragged_step_body(
+            params, tok, pos, token_page, token_slot, page_table,
+            ar, ones, lens, kv, cfg, tp_axis, paged_impl,
+            max_q_len=1, decode_rows=R)
+        return (nxt, pos + 1, kv, lens + 1), nxt
+
+    (_, positions, kv, seq_lens), toks_out = lax.scan(
+        one, (tokens, positions, kv, seq_lens), None, length=num_steps)
+    return toks_out, kv, positions, seq_lens
+
+
+#: module-level jits (shared compile cache across engine instances with
+#: equal shapes/statics — many short-lived engines, e.g. a test suite,
+#: must not each pay the XLA compile). tp.py wraps the raw bodies in
+#: shard_map instead.
+ragged_step = functools.partial(jax.jit, static_argnames=(
+    "cfg", "tp_axis", "paged_impl", "max_q_len", "decode_rows"),
+    donate_argnames=("kv",))(_ragged_step_body)
+
+ragged_decode_loop = functools.partial(jax.jit, static_argnames=(
+    "num_steps", "cfg", "tp_axis", "paged_impl"),
+    donate_argnames=("kv",))(_ragged_decode_loop)
+
+
+def _copy_page_body(kv: KVCache, src, dst) -> KVCache:
+    """Copy-on-write: duplicate one page across all layers — pages AND
+    their int8 scales, one tree_map (a prefix-hit sequence about to
+    write into a shared page copies it first). Plain body so tp.py can
+    shard_map it over local head shards."""
+    return jax.tree.map(
+        lambda leaf: leaf.at[:, dst].set(
+            lax.dynamic_index_in_dim(leaf, src, axis=1, keepdims=False)),
+        kv)
+
+
+copy_page = functools.partial(jax.jit, donate_argnames=("kv",))(
+    _copy_page_body)

@@ -6,29 +6,28 @@ bundles (reference: python/ray/llm/_internal/serve/deployments/llm/vllm/
 vllm_models.py:128-153 — worker count and STRICT_PACK/PACK groups derive
 from TP×PP degrees). TPU-first redesign: instead of one Ray worker
 process per shard coordinating over NCCL, ONE engine process drives a
-``jax.sharding.Mesh`` over the host's chips and the whole
-prefill/decode program is a single ``shard_map`` jit — XLA lays the two
-psums per layer (Megatron schedule) on ICI, and the Pallas paged-
-attention kernel runs per-shard on local heads (head-sliced attention
-needs no communication).
+``jax.sharding.Mesh`` over the host's chips and each of the THREE step
+programs (ragged mixed step, multi-step decode loop, COW page copy) is a
+single ``shard_map`` jit — XLA lays the two psums per layer (Megatron
+schedule) on ICI, and the ragged paged-attention kernel runs per-shard
+on local heads (head-sliced attention needs no communication).
 
 Layout (classic Megatron, weights arrive pre-sliced inside shard_map):
   - wq/wk/wv, w_gate/w_up: column-sharded (output dim over tp)
   - wo, w_down:            row-sharded (input dim over tp) + psum
   - embed, norms:          replicated (the 8B embed is ~1 GB bf16 —
                            small next to the sharded layers + KV pool)
-  - paged KV cache:        kv-head axis sharded — each chip holds
-                           Hkv/tp heads of EVERY page, so the page
+  - paged KV pool:         kv-head axis sharded — each chip holds
+                           Hkv/tp heads of EVERY page (int8 scale
+                           arrays shard the same axis), so the page
                            allocator stays global and unchanged
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models.llama import LlamaConfig, Params
@@ -38,10 +37,17 @@ TP_AXIS = "tp"
 
 #: paged KV pool [n_layers, pages, Hkv, page_size, D] — heads sharded
 CACHE_SPEC = P(None, None, TP_AXIS, None, None)
-#: prefill output [n_layers, T, Hkv, D]
-KV_ALL_SPEC = P(None, None, TP_AXIS, None)
-#: batched prefill output [N, n_layers, T, Hkv, D]
-KV_ALL_N_SPEC = P(None, None, None, TP_AXIS, None)
+#: int8 KV scale arrays [n_layers, pages, Hkv, page_size] — same axis
+SCALE_SPEC = P(None, None, TP_AXIS, None)
+
+
+def kv_specs(quantized: bool) -> dict:
+    """PartitionSpec tree matching cache.make_kv_cache's pytree."""
+    specs = {"k": CACHE_SPEC, "v": CACHE_SPEC}
+    if quantized:
+        specs["k_scale"] = SCALE_SPEC
+        specs["v_scale"] = SCALE_SPEC
+    return specs
 
 
 def tp_param_specs(cfg: LlamaConfig) -> Params:
@@ -103,15 +109,16 @@ def build_tp_mesh(tp: int,
 
 
 class TPEngineFns:
-    """The four device programs the engine dispatches, tp-sharded.
+    """The three device programs the engine dispatches, tp-sharded.
 
-    Call signatures mirror the single-chip jits in llm/engine.py so the
-    engine swaps implementations behind one seam. Built once per
-    (cfg, mesh); programs compile lazily per shape bucket exactly like
-    the single-chip path.
+    Call signatures mirror _SingleChipFns in llm/engine.py so the engine
+    swaps implementations behind one seam. Built once per (cfg, mesh);
+    every program has ONE static shape, so each compiles exactly once.
     """
 
-    def __init__(self, cfg: LlamaConfig, mesh: Mesh, decode_chunk: int):
+    def __init__(self, cfg: LlamaConfig, mesh: Mesh, *,
+                 decode_chunk: int, max_q_len: int, decode_rows: int,
+                 kv_quantized: bool = False):
         from ray_tpu.llm import model as M
         validate_tp(cfg, mesh.shape[TP_AXIS])
         self.cfg = cfg
@@ -119,89 +126,7 @@ class TPEngineFns:
         self.tp = mesh.shape[TP_AXIS]
         pspecs = tp_param_specs(cfg)
         rep = P()
-
-        def prefill_tok(params, tokens, true_len):
-            logits, k_all, v_all = M.prefill(params, tokens, true_len,
-                                             cfg, TP_AXIS)
-            return jnp.argmax(logits), k_all, v_all
-
-        self.prefill_tok = jax.jit(shard_map_compat(
-            prefill_tok, mesh=mesh,
-            in_specs=(pspecs, P(None, None), rep),
-            out_specs=(rep, KV_ALL_SPEC, KV_ALL_SPEC)))
-
-        def prefill_many_tok(params, tokens, true_lens):
-            logits, k_n, v_n = M.prefill_many(params, tokens, true_lens,
-                                              cfg, TP_AXIS)
-            return jnp.argmax(logits, axis=-1), k_n, v_n
-
-        self.prefill_many_tok = jax.jit(shard_map_compat(
-            prefill_many_tok, mesh=mesh,
-            in_specs=(pspecs, P(None, None), P(None)),
-            out_specs=(rep, KV_ALL_N_SPEC, KV_ALL_N_SPEC)))
-
-        def _wpp(t_page):
-            # local-shard scatter: pure data movement, no collectives
-            return jax.jit(shard_map_compat(
-                functools.partial(M.stage_prefill_kv, t_page=t_page),
-                mesh=mesh,
-                in_specs=(CACHE_SPEC, CACHE_SPEC, KV_ALL_SPEC,
-                          KV_ALL_SPEC, rep, P(None)),
-                out_specs=(CACHE_SPEC, CACHE_SPEC)),
-                donate_argnums=(0, 1))
-
-        self._wpp_cache = {}
-
-        def write_pages(k_cache, v_cache, k_all, v_all, true_len, pages,
-                        t_page):
-            fn = self._wpp_cache.get(t_page)
-            if fn is None:
-                fn = self._wpp_cache[t_page] = _wpp(t_page)
-            return fn(k_cache, v_cache, k_all, v_all, true_len, pages)
-
-        self.write_prefill_pages = write_pages
-
-        def _wppg(t_page):
-            return jax.jit(shard_map_compat(
-                functools.partial(M.stage_prefill_kv_group, t_page=t_page),
-                mesh=mesh,
-                in_specs=(CACHE_SPEC, CACHE_SPEC, KV_ALL_N_SPEC,
-                          KV_ALL_N_SPEC, P(None), P(None, None)),
-                out_specs=(CACHE_SPEC, CACHE_SPEC)),
-                donate_argnums=(0, 1))
-
-        self._wppg_cache = {}
-
-        def write_pages_group(k_cache, v_cache, k_n, v_n, true_lens,
-                              pages_n, t_page):
-            fn = self._wppg_cache.get(t_page)
-            if fn is None:
-                fn = self._wppg_cache[t_page] = _wppg(t_page)
-            return fn(k_cache, v_cache, k_n, v_n, true_lens, pages_n)
-
-        self.write_prefill_pages_group = write_pages_group
-
-        def chunk_tok(params, tokens, pages, prior_len, valid_len,
-                      k_cache, v_cache):
-            # per-shard: local kv-heads write their chunk KV and attend
-            # over the local head slice of the page pool; the two psums
-            # per layer inside _prefill_chunk_body close the TP seam
-            return M._prefill_chunk_body(params, tokens, pages, prior_len,
-                                         valid_len, k_cache, v_cache, cfg,
-                                         TP_AXIS)
-
-        self.prefill_chunk_tok = jax.jit(shard_map_compat(
-            chunk_tok, mesh=mesh,
-            in_specs=(pspecs, P(None, None), P(None), rep, rep,
-                      CACHE_SPEC, CACHE_SPEC),
-            out_specs=(rep, CACHE_SPEC, CACHE_SPEC)),
-            donate_argnums=(5, 6))
-
-        self.copy_page = jax.jit(shard_map_compat(
-            M._copy_page_body, mesh=mesh,
-            in_specs=(CACHE_SPEC, CACHE_SPEC, rep, rep),
-            out_specs=(CACHE_SPEC, CACHE_SPEC)),
-            donate_argnums=(0, 1))
+        kvs = kv_specs(kv_quantized)
 
         # the kernel/reference choice follows the MESH platform, not the
         # process default backend — a CPU test mesh inside a TPU-default
@@ -210,18 +135,50 @@ class TPEngineFns:
         paged_impl = "kernel" \
             if kernels_supported(mesh.devices.flat[0]) else "reference"
 
-        def decode(params, tokens, positions, k_cache, v_cache,
-                   page_table, seq_lens):
-            return M.decode_loop(params, tokens, positions, k_cache,
-                                 v_cache, page_table, seq_lens,
-                                 decode_chunk, cfg, TP_AXIS, paged_impl)
+        def step(params, tokens, token_pos, token_page, token_slot,
+                 page_table, q_start, q_len, kv_len, kv):
+            # per-shard: local kv-heads write their ragged K/V slice and
+            # attend over the local head slice of the page pool; the two
+            # psums per layer inside _ragged_step_body close the TP seam
+            return M._ragged_step_body(
+                params, tokens, token_pos, token_page, token_slot,
+                page_table, q_start, q_len, kv_len, kv, cfg, TP_AXIS,
+                paged_impl, max_q_len, decode_rows)
+
+        self.ragged_step = jax.jit(shard_map_compat(
+            step, mesh=mesh,
+            in_specs=(pspecs, P(None), P(None), P(None), P(None),
+                      P(None, None), P(None), P(None), P(None), kvs),
+            out_specs=(rep, kvs)),
+            donate_argnums=(9,))
+
+        def loop(params, tokens, positions, kv, page_table, seq_lens):
+            return M._ragged_decode_loop(
+                params, tokens, positions, kv, page_table, seq_lens,
+                decode_chunk, cfg, TP_AXIS, paged_impl)
 
         self.decode_loop = jax.jit(shard_map_compat(
-            decode, mesh=mesh,
-            in_specs=(pspecs, P(None), P(None), CACHE_SPEC, CACHE_SPEC,
-                      P(None, None), P(None)),
-            out_specs=(rep, CACHE_SPEC, CACHE_SPEC, rep, rep)),
-            donate_argnums=(3, 4))
+            loop, mesh=mesh,
+            in_specs=(pspecs, P(None), P(None), kvs, P(None, None),
+                      P(None)),
+            out_specs=(rep, kvs, rep, rep)),
+            donate_argnums=(3,))
+
+        self.copy_page = jax.jit(shard_map_compat(
+            M._copy_page_body, mesh=mesh,
+            in_specs=(kvs, rep, rep),
+            out_specs=kvs),
+            donate_argnums=(0,))
+
+    def compiled_step_programs(self) -> int:
+        """Resident compiled step programs for this mesh's fns."""
+        n = 0
+        for f in (self.ragged_step, self.decode_loop, self.copy_page):
+            try:
+                n += f._cache_size()
+            except AttributeError:
+                n += 1
+        return n
 
     # ------------------------------------------------------------ placement
 
@@ -231,6 +188,9 @@ class TPEngineFns:
             is_leaf=lambda x: isinstance(x, P))
         return jax.tree.map(jax.device_put, params, shardings)
 
-    def shard_caches(self, k_cache, v_cache):
-        sh = NamedSharding(self.mesh, CACHE_SPEC)
-        return jax.device_put(k_cache, sh), jax.device_put(v_cache, sh)
+    def shard_caches(self, kv: dict) -> dict:
+        return {name: jax.device_put(
+            leaf, NamedSharding(self.mesh,
+                                SCALE_SPEC if name.endswith("_scale")
+                                else CACHE_SPEC))
+            for name, leaf in kv.items()}

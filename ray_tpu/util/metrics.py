@@ -279,6 +279,33 @@ def llm_queue_depth_gauge() -> Gauge:
                  description="LLM requests waiting for admission")
 
 
+def llm_compiled_programs_gauge() -> Gauge:
+    """Compiled LLM step programs resident (ragged mixed step + decode
+    loop + COW page copy). O(1) by design — a rise means the engine
+    started recompiling on shape changes, the regression the ragged
+    single-dispatch step exists to prevent."""
+    return Gauge("llm_compiled_step_programs",
+                 description="compiled LLM step programs resident")
+
+
+def llm_dispatches_per_step_gauge() -> Gauge:
+    """Device dispatches per scheduler step over the gauge window
+    (ragged mixed steps + decode loops + COW copies). The steady-state
+    target is 1.0: each step is ONE program launch."""
+    return Gauge("llm_dispatches_per_step",
+                 description="device dispatches per engine step")
+
+
+def llm_padding_waste_gauge() -> Gauge:
+    """Fraction of ragged-step token slots that carried padding instead
+    of real prompt/decode tokens, over the gauge window — the cost of
+    the fixed ragged shape; high values say shrink prefill_rows or
+    prefill_chunk for this workload."""
+    return Gauge("llm_ragged_padding_waste",
+                 description="padding fraction of ragged step token "
+                             "slots (0..1)")
+
+
 def tune_running_trials_gauge() -> Gauge:
     """Trials currently holding an actor in this tuner process."""
     return Gauge("tune_running_trials",

@@ -30,6 +30,18 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from the tier-1 "
         "`-m 'not slow'` sweep")
+    config.addinivalue_line(
+        "markers", "pallas_interpret: Pallas TPU kernel tests that run "
+        "in interpret mode on the tier-1 CPU sweep (JAX_PLATFORMS=cpu) "
+        "— same kernel logic, emulated lowering")
+
+
+@pytest.fixture
+def pallas_interpret():
+    """Interpret flag for Pallas kernel tests: True off-TPU (tier-1 runs
+    the kernels via the Pallas interpreter on CPU), False on real TPU
+    where the compiled kernel itself should be exercised."""
+    return jax.default_backend() != "tpu"
 
 
 @pytest.fixture

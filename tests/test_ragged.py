@@ -1,0 +1,223 @@
+"""Ragged paged-attention tests: the single-dispatch mixed
+prefill+decode kernel (ops/paged_attention.py ragged_* APIs) against a
+dense per-token oracle, across GQA configs, page-boundary-straddling
+chunks, degenerate single-row batches, and int8-quantized KV pages.
+
+The Pallas kernel runs in interpret mode (pallas_interpret marker) so
+the kernel logic — scalar-prefetched page indexing, per-token causal
+visibility, online softmax across the page grid axis — is exercised in
+tier-1 on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.int8 import dequantize_kv, quantize_kv
+from ray_tpu.ops.paged_attention import (_ragged_attention_pallas,
+                                         paged_attention_reference,
+                                         ragged_paged_attention,
+                                         ragged_paged_attention_reference,
+                                         write_ragged_kv)
+
+
+def _dense_oracle(q, kp, vp, pt, q_start, q_len, kv_len,
+                  k_scale=None, v_scale=None):
+    """Per-token dense attention: gather row pages, causal-mask by the
+    token's absolute position, fp32 softmax. Padding tokens -> 0."""
+    q, kp, vp = map(lambda a: np.asarray(a, np.float64), (q, kp, vp))
+    if k_scale is not None:
+        kp = kp * np.asarray(k_scale, np.float64)[..., None]
+        vp = vp * np.asarray(v_scale, np.float64)[..., None]
+    T, Hq, D = q.shape
+    Hkv, ps = kp.shape[1], kp.shape[2]
+    g = Hq // Hkv
+    out = np.zeros((T, Hq, D))
+    for r in range(len(q_start)):
+        for j in range(int(q_len[r])):
+            t = int(q_start[r]) + j
+            vis = int(kv_len[r]) - int(q_len[r]) + j + 1
+            pages = np.asarray(pt[r])[: -(-vis // ps)]
+            k = kp[pages].transpose(1, 0, 2, 3).reshape(Hkv, -1, D)[:, :vis]
+            v = vp[pages].transpose(1, 0, 2, 3).reshape(Hkv, -1, D)[:, :vis]
+            qg = q[t].reshape(Hkv, g, D)
+            s = np.einsum("hgd,htd->hgt", qg, k) * D ** -0.5
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[t] = np.einsum("hgt,htd->hgd", p, v).reshape(Hq, D)
+    return out
+
+
+def _mixed_batch(key, Hq, Hkv, D, ps=8, pages=12, max_pages=4):
+    """2 decode rows + 1 inactive row + 2 prefill chunks, one chunk
+    straddling a page boundary (ends mid-page after crossing one)."""
+    ks = jax.random.split(key, 3)
+    T = 16
+    q = jax.random.normal(ks[0], (T, Hq, D), jnp.float32)
+    kp = jax.random.normal(ks[1], (pages, Hkv, ps, D), jnp.float32)
+    vp = jax.random.normal(ks[2], (pages, Hkv, ps, D), jnp.float32)
+    pt = jnp.array([[1, 2, 3, 4], [5, 6, 7, 8], [0, 0, 0, 0],
+                    [9, 10, 11, 1], [2, 3, 4, 5]], jnp.int32)
+    # rows: decode len 11, decode len 24, inactive, 6-tok chunk ending
+    # at kv position 21 (straddles the page-2 -> page-3 boundary), 4-tok
+    # chunk fully inside page 0 of its table
+    q_start = jnp.array([0, 1, 0, 3, 9], jnp.int32)
+    q_len = jnp.array([1, 1, 0, 6, 4], jnp.int32)
+    kv_len = jnp.array([11, 24, 0, 21, 4], jnp.int32)
+    return q, kp, vp, pt, q_start, q_len, kv_len
+
+
+@pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 4), (8, 1)])
+def test_ragged_reference_matches_dense_gqa(Hq, Hkv):
+    args = _mixed_batch(jax.random.PRNGKey(Hq * 10 + Hkv), Hq, Hkv, 32)
+    want = _dense_oracle(*args)
+    got = ragged_paged_attention_reference(*args, max_q_len=6,
+                                           decode_rows=2)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+    # cost hints must be cost-only: no hints, same numbers
+    got2 = ragged_paged_attention_reference(*args)
+    np.testing.assert_allclose(np.asarray(got2), want, atol=1e-5)
+    # padding tokens (owned by no row) must come back exactly zero
+    owned = np.zeros(args[0].shape[0], bool)
+    for s, l in zip(args[4], args[5]):
+        owned[int(s):int(s) + int(l)] = True
+    assert np.all(np.asarray(got)[~owned] == 0.0)
+
+
+@pytest.mark.pallas_interpret
+@pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 4), (8, 1)])
+def test_ragged_pallas_interpret_matches_reference(Hq, Hkv, pallas_interpret):
+    D = 128   # lane-width head_dim, the TPU-shaped case
+    args = _mixed_batch(jax.random.PRNGKey(Hq + Hkv), Hq, Hkv, D, ps=16)
+    ref = ragged_paged_attention_reference(*args)
+    out = _ragged_attention_pallas(*args, None, None, D ** -0.5,
+                                   interpret=pallas_interpret)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2)
+
+
+@pytest.mark.pallas_interpret
+def test_ragged_pallas_int8_pages(pallas_interpret):
+    Hq, Hkv, D = 8, 4, 128
+    q, kp, vp, pt, q_start, q_len, kv_len = _mixed_batch(
+        jax.random.PRNGKey(11), Hq, Hkv, D, ps=16)
+    kq, ksc = quantize_kv(kp)
+    vq, vsc = quantize_kv(vp)
+    ref = ragged_paged_attention_reference(q, kq, vq, pt, q_start, q_len,
+                                           kv_len, k_scale=ksc,
+                                           v_scale=vsc)
+    out = _ragged_attention_pallas(q, kq, vq, pt, q_start, q_len, kv_len,
+                                   ksc, vsc, D ** -0.5,
+                                   interpret=pallas_interpret)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2)
+    # and the int8 path stays close to unquantized attention
+    fp = ragged_paged_attention_reference(q, kp, vp, pt, q_start, q_len,
+                                          kv_len)
+    assert float(jnp.max(jnp.abs(ref - fp))) < 0.05
+
+
+def test_ragged_single_row_degenerate():
+    """R=1 batches — one decode row, then one prefill row — must work
+    (the scheduler emits these when the engine idles down)."""
+    key = jax.random.PRNGKey(5)
+    Hq, Hkv, D, ps = 4, 2, 32, 8
+    ks = jax.random.split(key, 3)
+    kp = jax.random.normal(ks[1], (6, Hkv, ps, D), jnp.float32)
+    vp = jax.random.normal(ks[2], (6, Hkv, ps, D), jnp.float32)
+    pt = jnp.array([[1, 2, 3]], jnp.int32)
+    q1 = jax.random.normal(ks[0], (1, Hq, D), jnp.float32)
+    dec = ragged_paged_attention_reference(
+        q1, kp, vp, pt, jnp.array([0]), jnp.array([1]), jnp.array([17]))
+    want = _dense_oracle(q1, kp, vp, pt, [0], [1], [17])
+    np.testing.assert_allclose(np.asarray(dec), want, atol=1e-5)
+    q5 = jax.random.normal(ks[0], (5, Hq, D), jnp.float32)
+    pf = ragged_paged_attention_reference(
+        q5, kp, vp, pt, jnp.array([0]), jnp.array([5]), jnp.array([13]))
+    want = _dense_oracle(q5, kp, vp, pt, [0], [5], [13])
+    np.testing.assert_allclose(np.asarray(pf), want, atol=1e-5)
+
+
+def test_ragged_all_decode_matches_decode_reference():
+    """An all-decode ragged batch is exactly the old decode attention:
+    the two references must agree bit-for-bit-ish (same math path)."""
+    key = jax.random.PRNGKey(9)
+    B, Hq, Hkv, D, ps = 4, 8, 4, 64, 8
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+    kp = jax.random.normal(ks[1], (10, Hkv, ps, D), jnp.float32)
+    vp = jax.random.normal(ks[2], (10, Hkv, ps, D), jnp.float32)
+    pt = jnp.array([[1, 2, 3], [4, 5, 6], [7, 8, 9], [1, 4, 7]],
+                   jnp.int32)
+    sl = jnp.array([11, 24, 5, 17], jnp.int32)
+    dec = paged_attention_reference(q, kp, vp, pt, sl)
+    rag = ragged_paged_attention_reference(
+        q, kp, vp, pt, jnp.arange(B, dtype=jnp.int32),
+        jnp.ones(B, jnp.int32), sl, decode_rows=B, max_q_len=1)
+    np.testing.assert_allclose(np.asarray(rag), np.asarray(dec),
+                               atol=1e-5)
+
+
+def test_ragged_dispatcher_interpret_path():
+    """The public entry point routes to the kernel (interpret=True on
+    CPU) and matches the reference on a mixed batch."""
+    args = _mixed_batch(jax.random.PRNGKey(2), 8, 4, 128, ps=16)
+    ref = ragged_paged_attention_reference(*args)
+    out = ragged_paged_attention(*args, impl="kernel", interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2)
+
+
+# ---------------------------------------------------------------- int8 KV
+
+
+def test_int8_kv_roundtrip_error_bound():
+    """Per-(token, head) int8 KV quantization: round-trip error within
+    the 1/127 step bound for unit-scale rows, including bf16 scales."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 4, 64), jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (64, 4)
+    back = dequantize_kv(q, s)
+    err = float(jnp.max(jnp.abs(back - x)))
+    # step/2 = amax/254 plus bf16 scale rounding (2^-8 relative)
+    amax = float(jnp.max(jnp.abs(x)))
+    assert err < amax * (1 / 254 + 2 ** -8) * 1.5, err
+
+
+def test_write_ragged_kv_fp_and_int8():
+    key = jax.random.PRNGKey(4)
+    Hkv, ps, D, P, T = 2, 8, 16, 5, 10
+    ks = jax.random.split(key, 2)
+    k_t = jax.random.normal(ks[0], (T, Hkv, D), jnp.float32)
+    v_t = jax.random.normal(ks[1], (T, Hkv, D), jnp.float32)
+    page = jnp.array([1, 1, 1, 2, 2, 3, 3, 3, 4, 0], jnp.int32)
+    slot = jnp.array([0, 1, 2, 5, 6, 0, 1, 7, 3, 0], jnp.int32)
+    # fp path
+    kp = jnp.zeros((P, Hkv, ps, D), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    kp2, vp2, ksc, vsc = write_ragged_kv(kp, vp, k_t, v_t, page, slot)
+    assert ksc is None and vsc is None
+    for t in range(T):
+        np.testing.assert_allclose(
+            np.asarray(kp2[page[t], :, slot[t]]), np.asarray(k_t[t]))
+        np.testing.assert_allclose(
+            np.asarray(vp2[page[t], :, slot[t]]), np.asarray(v_t[t]))
+    # int8 path: scatter quantized rows + scales, round-trip bounded
+    kq = jnp.zeros((P, Hkv, ps, D), jnp.int8)
+    vq = jnp.zeros_like(kq)
+    from ray_tpu.ops.int8 import KV_SCALE_DTYPE
+    ks8 = jnp.zeros((P, Hkv, ps), KV_SCALE_DTYPE)
+    vs8 = jnp.zeros_like(ks8)
+    kq2, vq2, ks2, vs2 = write_ragged_kv(kq, vq, k_t, v_t, page, slot,
+                                         ks8, vs8)
+    assert kq2.dtype == jnp.int8 and ks2.dtype == KV_SCALE_DTYPE
+    for t in range(T - 1):   # last token aliases scratch page 0
+        got = dequantize_kv(kq2[page[t], :, slot[t]],
+                            ks2[page[t], :, slot[t]])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(k_t[t]),
+                                   atol=2e-2)
+        got = dequantize_kv(vq2[page[t], :, slot[t]],
+                            vs2[page[t], :, slot[t]])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(v_t[t]),
+                                   atol=2e-2)
