@@ -52,17 +52,34 @@ def main() -> None:
     while eng.has_work():
         eng.step()
     group_p50s = []
+    ttft_pairs = []      # (external timer, flight-recorder TTFT) per sample
     for _group in range(3):
         samples = []
         for _ in range(7):
             t0 = time.perf_counter()
-            eng.add_request(mk_prompt(next(uniq)), max_new_tokens=1)
+            rid = eng.add_request(mk_prompt(next(uniq)), max_new_tokens=1)
             eng.step()
             samples.append(time.perf_counter() - t0)
             while eng.has_work():
                 eng.step()
+            rec = eng.request_log.get(rid)
+            if rec is not None and rec.ttft is not None:
+                ttft_pairs.append((samples[-1], rec.ttft))
         group_p50s.append(sorted(samples)[len(samples) // 2])
     ttft = max(group_p50s)  # worst consecutive p50 carries the claim
+
+    # --- flight-recorder TTFT must agree with the external timer: the
+    # record clock starts at enqueue and stops at the dispatch readback,
+    # so it reads <= the external sample by only the step's Python
+    # bookkeeping. Tolerance max(5ms, 15%); disagreement means the
+    # recorder's timeline is fiction and the bench dies here.
+    assert ttft_pairs, "recorder produced no TTFT records"
+    ttft_err = max(abs(ext - rec) for ext, rec in ttft_pairs)
+    for ext, rec in ttft_pairs:
+        tol = max(0.005, 0.15 * ext)
+        assert abs(ext - rec) <= tol, \
+            f"record TTFT {rec * 1e3:.2f}ms vs timer {ext * 1e3:.2f}ms " \
+            f"(tolerance {tol * 1e3:.2f}ms)"
 
     # --- TTFT with a prefix-cache hit: a 96-token shared system prefix
     # (3 full 32-token pages, page-aligned) + a distinct 32-token tail
@@ -122,8 +139,8 @@ def main() -> None:
     # --- steady-state decode throughput at full batch (256 new tokens =
     # 8 decode chunks; the burst admits in ONE step now, so warm 2 steps
     # and measure the remaining 6 — warming 4 of 4 chunks measured zero)
-    for _ in range(8):
-        eng.add_request(mk_prompt(next(uniq)), max_new_tokens=256)
+    decode_rids = [eng.add_request(mk_prompt(next(uniq)),
+                                   max_new_tokens=256) for _ in range(8)]
     # warm the decode program + fill the batch
     for _ in range(2):
         eng.step()
@@ -134,6 +151,21 @@ def main() -> None:
     dt = time.perf_counter() - t0
     toks = eng.stats["decode_tokens"] - toks0
     steps = eng.stats["decode_steps"] - steps0
+
+    # --- record-derived serving latencies for the batch-8 decoders:
+    # TTFT/TPOT straight off the flight-recorder records, ITL from the
+    # per-dispatch decode entries (delta_ts / tokens-in-dispatch — the
+    # honest per-token latency at decode_chunk granularity)
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+
+    drecs = [eng.request_log.get(r) for r in decode_rids]
+    drecs = [r for r in drecs if r is not None and r.done]
+    rec_ttfts = [r.ttft for r in drecs if r.ttft is not None]
+    rec_tpots = [r.tpot for r in drecs if r.tpot is not None]
+    itls = [e_dt / e_n for r in drecs
+            for e_dt, e_n in r.decode_entries() if e_n]
 
     # --- decode throughput WHILE long prompts chunk-prefill into the
     # free slots: 6 decoders (prompt 128, 256 new tokens) run while
@@ -185,6 +217,35 @@ def main() -> None:
                           kv_dtype="int8")
     cap_ratio = (sum(x.nbytes for x in kv_fp.values())
                  / sum(x.nbytes for x in kv_q8.values()))
+
+    # --- recorder overhead: the same decode protocol (8 prompts, 64 new
+    # tokens) on two fresh engines SHARING eng's params and warm jit
+    # caches, recorder on vs off. Plus the recorder's raw per-event cost
+    # (one note_decode), which bounds what the engine loop can ever pay.
+    def timed_run(recorder_on: bool) -> float:
+        e = InferenceEngine(cfg, eng.params, page_size=32,
+                            total_pages=1024, max_batch=8,
+                            max_seq_len=512, decode_chunk=32,
+                            prefill_chunk=128,
+                            request_log=recorder_on)
+        for _ in range(8):
+            e.add_request(mk_prompt(next(uniq)), max_new_tokens=64)
+        e.step()                       # admit + burst prefill
+        t0 = time.perf_counter()
+        while e.has_work():
+            e.step()
+        return time.perf_counter() - t0
+
+    t_off = timed_run(False)
+    t_on = timed_run(True)
+    overhead = t_on / t_off - 1.0
+
+    from ray_tpu.llm.request_log import RequestRecord
+    probe_rec = RequestRecord("probe", 1, 1 << 20)
+    t0 = time.perf_counter()
+    for i in range(100_000):
+        probe_rec.note_decode(t0 + i * 1e-6, 1)
+    event_ns = (time.perf_counter() - t0) / 100_000 * 1e9
 
     out = [
         {"metric": "llm_ttft_p50", "value": round(ttft * 1000, 2),
@@ -244,6 +305,38 @@ def main() -> None:
                  f"{eng.stats['ragged_slot_tokens']} ragged token slots "
                  "computed; padded slots attend the scratch page and are "
                  "discarded"},
+        {"metric": "llm_ttft_record_agreement",
+         "value": round(ttft_err * 1000, 3), "unit": "ms",
+         "vs_baseline": None,
+         "meets_target": True,   # asserted above: bench dies otherwise
+         "note": "max |flight-recorder TTFT - external timer| over the "
+                 f"{len(ttft_pairs)} locked-protocol samples; tolerance "
+                 "max(5ms, 15%) enforced by assertion — the record "
+                 "timeline is the timer, not an estimate"},
+        {"metric": "llm_record_ttft_p50",
+         "value": round((pct(rec_ttfts, 0.5) or 0.0) * 1000, 2),
+         "unit": "ms", "vs_baseline": None,
+         "note": "record-derived TTFT p50 of the 8 queued batch decoders "
+                 f"(p99 {round((pct(rec_ttfts, 0.99) or 0.0) * 1000, 2)}"
+                 "ms); includes queue wait — these arrived as one burst"},
+        {"metric": "llm_record_tpot_p50",
+         "value": round((pct(rec_tpots, 0.5) or 0.0) * 1000, 3),
+         "unit": "ms", "vs_baseline": None,
+         "note": "record-derived mean inter-token latency p50 across the "
+                 "8 decoders, 256 tokens each "
+                 f"(p99 {round((pct(rec_tpots, 0.99) or 0.0) * 1000, 3)}"
+                 "ms); per-dispatch ITL p50 "
+                 f"{round((pct(itls, 0.5) or 0.0) * 1000, 3)}ms / p99 "
+                 f"{round((pct(itls, 0.99) or 0.0) * 1000, 3)}ms at "
+                 "decode_chunk granularity"},
+        {"metric": "llm_recorder_overhead", "value": round(overhead, 4),
+         "unit": "fraction", "vs_baseline": None,
+         "meets_target": bool(overhead <= 0.02),
+         "note": "decode wall-time (8 reqs x 64 tok) recorder-on vs "
+                 f"recorder-off, same params + warm jits; raw cost "
+                 f"{event_ns:.0f}ns per note_decode event (preallocated "
+                 "slots, O(1)); target <= 2% — single-run A/B, so "
+                 "scheduler noise can dominate the true per-event cost"},
         {"metric": "llm_int8_kv_capacity", "value": round(cap_ratio, 2),
          "unit": "x", "vs_baseline": None,
          "meets_target": bool(cap_ratio >= 1.9),

@@ -74,6 +74,10 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_admit_age_cap_s": (float, 5.0, "a head request older than this stops lookahead skipping so freed pages go to it first (no starvation)"),
     "llm_kv_dtype": (str, "model", "KV page storage scheme: 'model' (engine dtype) or 'int8' (quantized pages + bf16 per-token scales; ~1.9x concurrent sequences per HBM byte at head_dim 64)"),
     "llm_ragged_prefill_rows": (int, 2, "prefill-chunk rows packed into each ragged step dispatch (ragged token capacity = max_batch + rows*prefill_chunk); more rows advance more prompts per step at the cost of padding when the queue is shallow"),
+    "llm_request_log": (bool, True, "per-request flight recorder (lifecycle events, TTFT/TPOT histograms, 'python -m ray_tpu requests'); disable to shave the last % off the step loop"),
+    "llm_request_log_size": (int, 256, "request records kept in the engine-side ring (and in the head-side aggregate ring); oldest finished records evict first"),
+    "llm_slo_ttft_ms": (float, 200.0, "time-to-first-token SLO target; llm_slo_ttft_attainment reports the fraction of finished requests under it"),
+    "llm_slo_tpot_ms": (float, 20.0, "time-per-output-token SLO target (mean inter-token latency after the first); llm_slo_tpot_attainment reports attainment"),
     # --- misc ---
     "session_dir": (str, "/tmp/ray_tpu", "root for session artifacts"),
     "log_to_driver": (bool, True, "forward worker logs to driver"),

@@ -19,6 +19,9 @@ Endpoints:
   GET /api/timeseries?node=N&metric=M&last=K&latest=1
                    hardware time-series rings (per node x metric; fed by
                    the node daemons' 2s samplers)
+  GET /api/requests?live=1&slowest=N&request=RID
+                   LLM request flight-recorder records (per-request
+                   lifecycle timelines aggregated at the head)
   GET /api/timeline task spans (chrome-trace convertible)
   GET /api/jobs    submitted jobs
   GET /api/nodes   per-node agent stats (cpu/mem/disk/store/worker RSS —
@@ -116,6 +119,18 @@ class Dashboard:
                             payload = {"latest": True,
                                        "max_age_s": 120.0}
                         data = client.call("timeseries_dump", payload,
+                                           timeout=10)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
+                    if parsed.path == "/api/requests":
+                        q = parse_qs(parsed.query)
+                        payload = {
+                            "live": bool(q.get("live", [""])[0]),
+                            "slowest": q.get("slowest", ["0"])[0],
+                            "request": q.get("request", [""])[0],
+                        }
+                        data = client.call("requests_dump", payload,
                                            timeout=10)
                         self._send(200, json.dumps(
                             data, default=str).encode(), "application/json")

@@ -6,14 +6,42 @@ python/ray/llm/_internal/serve/ — vLLM engine wrapper, deployment,
 OpenAI-style router), rebuilt on JAX/Pallas instead of vLLM/CUDA:
 ops/paged_attention.py is the decode kernel, llm/engine.py the
 continuous-batching loop, llm/serve_llm.py the serve deployment.
+
+Submodules import lazily (PEP 562): the jax-heavy engine/serve stack
+only loads when its names are touched, so jax-free pieces like
+``ray_tpu.llm.request_log`` stay importable in processes (and tier-1
+tests) that never build an engine.
 """
 
-from ray_tpu.llm.batch import LLMBatchPredictor, batch_inference
-from ray_tpu.llm.cache import PageAllocator, PrefixCache, make_kv_cache
-from ray_tpu.llm.engine import InferenceEngine
-from ray_tpu.llm.serve_llm import (LLMServer, build_llm_app,
-                                   placement_for_engine)
+_LAZY = {
+    "LLMBatchPredictor": ("ray_tpu.llm.batch", "LLMBatchPredictor"),
+    "batch_inference": ("ray_tpu.llm.batch", "batch_inference"),
+    "PageAllocator": ("ray_tpu.llm.cache", "PageAllocator"),
+    "PrefixCache": ("ray_tpu.llm.cache", "PrefixCache"),
+    "make_kv_cache": ("ray_tpu.llm.cache", "make_kv_cache"),
+    "InferenceEngine": ("ray_tpu.llm.engine", "InferenceEngine"),
+    "LLMServer": ("ray_tpu.llm.serve_llm", "LLMServer"),
+    "build_llm_app": ("ray_tpu.llm.serve_llm", "build_llm_app"),
+    "placement_for_engine": ("ray_tpu.llm.serve_llm",
+                             "placement_for_engine"),
+    "FlightRecorder": ("ray_tpu.llm.request_log", "FlightRecorder"),
+    "RequestRecord": ("ray_tpu.llm.request_log", "RequestRecord"),
+}
 
-__all__ = ["InferenceEngine", "LLMServer", "PageAllocator",
-           "PrefixCache", "make_kv_cache", "batch_inference",
-           "LLMBatchPredictor", "build_llm_app", "placement_for_engine"]
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -287,6 +287,14 @@ class SequenceState:
         self.num_computed = 0
         self.cached_tokens = 0              # served from the prefix cache
         self.prefilling = False
+        # recompute-preemption state: a preempted sequence folds its
+        # generated tokens into the prompt, re-prefills, then restores
+        # the split in _postfill_book (n_prompt marks the original
+        # boundary; restore_generated stashes the folded tokens)
+        self.n_prompt = len(self.prompt)
+        self.preempt_count = 0
+        self.restore_generated: List[int] = []
+        self.record = None                  # flight-recorder RequestRecord
 
     @property
     def num_tokens(self) -> int:

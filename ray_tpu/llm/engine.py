@@ -43,6 +43,7 @@ import collections
 import itertools
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -114,6 +115,7 @@ class InferenceEngine:
                  admit_age_cap_s: Optional[float] = None,
                  kv_dtype: Optional[str] = None,
                  prefill_rows: Optional[int] = None,
+                 request_log: Optional[bool] = None,
                  tp: int = 1, devices=None):
         from ray_tpu.core.config import GlobalConfig
         self.cfg = cfg
@@ -188,6 +190,10 @@ class InferenceEngine:
         self._chunking: List[SequenceState] = []
         self._slots: List[Optional[SequenceState]] = [None] * max_batch
         self._req_ids = itertools.count()
+        # engines count requests independently, but their records meet in
+        # ONE head-side table (requests_dump keyed by rid): a per-engine
+        # nonce keeps req ids unique across replicas/processes
+        self._rid_nonce = uuid.uuid4().hex[:6]
         self._lock = threading.Lock()
         # device-side decode inputs (fixed shapes)
         self._page_table = np.full((max_batch, self.max_pages_per_seq),
@@ -198,7 +204,19 @@ class InferenceEngine:
                       "decode_steps": 0, "decode_tokens": 0,
                       "decode_dispatches": 0, "cached_tokens": 0,
                       "ragged_dispatches": 0, "ragged_real_tokens": 0,
-                      "ragged_slot_tokens": 0, "cow_copies": 0}
+                      "ragged_slot_tokens": 0, "cow_copies": 0,
+                      "preemptions": 0}
+        # per-request flight recorder (llm/request_log.py): lifecycle
+        # event stream per request + TTFT/TPOT/e2e/queue-wait histograms
+        # + SLO attainment; None disables every hook (seq.record stays
+        # None, so the step loop pays one is-None check per event)
+        use_reclog = GlobalConfig.llm_request_log \
+            if request_log is None else request_log
+        if use_reclog:
+            from ray_tpu.llm.request_log import FlightRecorder
+            self.request_log: Optional[FlightRecorder] = FlightRecorder()
+        else:
+            self.request_log = None
         self._finished_at_prefill: Dict[str, List[int]] = {}
         # tokens generated since the last drain_progress() call, per live
         # request — the incremental surface token streaming rides on
@@ -226,31 +244,39 @@ class InferenceEngine:
         self._g_programs = metrics_mod.llm_compiled_programs_gauge()
         self._g_dispatches = metrics_mod.llm_dispatches_per_step_gauge()
         self._g_pad_waste = metrics_mod.llm_padding_waste_gauge()
+        self._g_slo_ttft = metrics_mod.llm_slo_ttft_attainment_gauge()
+        self._g_slo_tpot = metrics_mod.llm_slo_tpot_attainment_gauge()
+        self._g_preempts = metrics_mod.llm_preemptions_gauge()
         self._metrics_ts = time.monotonic()
         self._metrics_last = dict(self.stats)
 
     # ------------------------------------------------------------ requests
 
     def add_request(self, prompt: List[int], max_new_tokens: int = 32,
-                    ) -> str:
+                    trace_id: str = "") -> str:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + max_new_tokens > \
                 self.max_pages_per_seq * self.page_size:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
-        seq = SequenceState("probe", prompt, max_new_tokens)
-        if seq.pages_needed(self.page_size, headroom=1) > \
+        probe = SequenceState("probe", prompt, max_new_tokens)
+        if probe.pages_needed(self.page_size, headroom=1) > \
                 self.allocator.total_pages - 1:
             # unsatisfiable even with an empty pool: reject now rather
             # than spinning _admit forever at the head of the queue
             raise ValueError(
                 f"prompt needs more pages than the cache holds "
                 f"({self.allocator.total_pages - 1} allocatable)")
-        rid = f"req-{next(self._req_ids)}"
+        rid = f"req-{self._rid_nonce}-{next(self._req_ids)}"
+        seq = SequenceState(rid, prompt, max_new_tokens,
+                            enqueue_ts=time.monotonic())
+        if self.request_log is not None:
+            # flight-recorder lifecycle starts at enqueue; the caller's
+            # trace_id (serve router span) links record <-> trace tree
+            seq.record = self.request_log.start(
+                rid, len(prompt), max_new_tokens, trace_id=trace_id)
         with self._lock:
-            self.waiting.append(SequenceState(
-                rid, prompt, max_new_tokens,
-                enqueue_ts=time.monotonic()))
+            self.waiting.append(seq)
         return rid
 
     def has_work(self) -> bool:
@@ -342,6 +368,8 @@ class InferenceEngine:
                 tail_pages = self._alloc_pages(need)
                 if tail_pages is None:
                     self._unmatch(matched_pages)
+                    if seq.record is not None:
+                        seq.record.note_stall(now)
                     if seq is head and head_aged:
                         break  # aged head waits for memory first
                     continue
@@ -351,6 +379,8 @@ class InferenceEngine:
                 seq.prefilling = True
                 seq.num_computed = matched
                 seq.cached_tokens = matched
+                if seq.record is not None:
+                    seq.record.note_admit(now, matched)
                 self._slots[slot] = seq
                 admitted.append((seq, matched_pages, tail_pages, cow))
         for seq, matched_pages, tail_pages, cow in admitted:
@@ -443,8 +473,10 @@ class InferenceEngine:
             jnp.asarray(ptab), jnp.asarray(q_start), jnp.asarray(q_len),
             jnp.asarray(kv_len), self.kv)
         nxt = np.asarray(nxt)                      # [R], ONE readback
+        now = time.monotonic()
         chunk_tokens = sum(C for _, C in rows)
         self.stats["ragged_dispatches"] += 1
+        disp_idx = self.stats["ragged_dispatches"]
         self.stats["ragged_real_tokens"] += len(active) + chunk_tokens
         self.stats["ragged_slot_tokens"] += Tcap
         self.stats["prefill_tokens"] += chunk_tokens
@@ -458,6 +490,8 @@ class InferenceEngine:
                 self._finish(slot, seq, finished)
                 continue
             seq.generated.append(tok)
+            if seq.record is not None:
+                seq.record.note_decode(now, 1)
             if self.track_progress:
                 self._progress.setdefault(seq.request_id, []).append(tok)
             if len(seq.generated) >= seq.max_new_tokens:
@@ -467,6 +501,8 @@ class InferenceEngine:
             self._positions[slot] = seq.num_tokens - 1
         for j, (seq, C) in enumerate(rows):
             seq.num_computed += C
+            if seq.record is not None:
+                seq.record.note_chunk(now, C, disp_idx)
             if seq.num_computed >= len(seq.prompt):
                 self._chunking.remove(seq)
                 seq.prefilling = False
@@ -490,22 +526,43 @@ class InferenceEngine:
         if self.prefix is not None:
             # registering BEFORE a possible immediate finish keeps
             # recently-finished prompts reusable (their pages go
-            # evictable-LRU, not back to the free list)
+            # evictable-LRU, not back to the free list); for a preempted
+            # sequence the prompt is still FOLDED here, so the pages
+            # holding generated-token KV publish too
             self.prefix.register(seq.prompt, pages)
-        done_now = seq.max_new_tokens <= 1 \
-            or (self.eos_token is not None and first_tok == self.eos_token)
+        now = time.monotonic()
+        if seq.restore_generated:
+            # recompute re-prefill done: unfold the prompt/generated
+            # split (the folded re-prefill recomputed KV for every
+            # generated token; first_tok is the NEXT token after them —
+            # greedy sampling makes the continuation identical)
+            seq.prompt = seq.prompt[:seq.n_prompt]
+            seq.generated = list(seq.restore_generated)
+            seq.restore_generated = []
+        eos_now = self.eos_token is not None and first_tok == self.eos_token
+        if seq.record is not None:
+            if eos_now:
+                seq.record.note_first(now)  # sampled, but never emitted
+            else:
+                seq.record.note_decode(now, 1)
+        done_now = eos_now or len(seq.generated) + 1 >= seq.max_new_tokens
         if done_now:
-            # first sampled token is EOS (drop it) or max_new_tokens == 1
-            # (keep it): finish without ever joining the decode batch
-            out = [] if (self.eos_token is not None
-                         and first_tok == self.eos_token) else [first_tok]
+            # first sampled token is EOS (drop it) or it used up the
+            # token budget (keep it): finish without (re-)joining the
+            # decode batch
+            new = [] if eos_now else [first_tok]
+            out = seq.generated + new
             seq.generated = out
             seq.done = True
             self._finished_at_prefill[seq.request_id] = out
-            if out and self.track_progress:
-                self._progress.setdefault(seq.request_id, []).extend(out)
+            if new and self.track_progress:
+                # only the NEW token streams; restored tokens already did
+                self._progress.setdefault(seq.request_id, []).extend(new)
             self._note_finish(seq.request_id,
-                              "stop" if not out else "length")
+                              "stop" if eos_now else "length")
+            if self.request_log is not None and seq.record is not None:
+                self.request_log.finish(
+                    seq.record, now, "stop" if eos_now else "length")
             self._release_pages(pages)
             if seq.slot is not None:
                 self._slots[seq.slot] = None
@@ -528,6 +585,10 @@ class InferenceEngine:
                 finished: Dict[str, List[int]]) -> None:
         if seq.request_id not in self._finish_reasons:
             self._note_finish(seq.request_id, "length")
+        if self.request_log is not None and seq.record is not None:
+            self.request_log.finish(
+                seq.record, time.monotonic(),
+                self._finish_reasons.get(seq.request_id, "length"))
         seq.done = True
         finished[seq.request_id] = list(seq.generated)
         self._release_pages(seq.pages)
@@ -546,13 +607,63 @@ class InferenceEngine:
         while len(seq.pages) < need:
             extra = self._alloc_pages(1)
             if extra is None:
-                # out of cache: finish the sequence early (MVP policy;
-                # vLLM would preempt/swap instead)
-                self._finish(slot, seq, finished)
+                # out of cache: preempt by recompute (vLLM's default
+                # preemption mode) — release this sequence's pages and
+                # re-queue it at the waiting head; repeat offenders and
+                # unsatisfiable sequences finish with reason "evict"
+                self._preempt(slot, seq, finished)
                 return False
             self._page_table[slot, len(seq.pages)] = extra[0]
             seq.pages.extend(extra)
         return True
+
+    #: recompute-preemptions allowed per sequence before it finishes
+    #: "evict" — bounds ping-pong livelock under a pool that cannot hold
+    #: the working set
+    PREEMPT_CAP = 4
+
+    def _preempt(self, slot: int, seq: SequenceState,
+                 finished: Dict[str, List[int]]) -> None:
+        """Recompute preemption: drop the sequence's pages and re-queue
+        it at the waiting head. Its generated tokens FOLD into the prompt
+        so the re-prefill (which rides the chunked path, prefix-matching
+        the just-released pages when the cache holds them) recomputes
+        their KV and re-samples the continuation; _postfill_book unfolds
+        the split. Greedy argmax sampling makes the continuation
+        identical to the uninterrupted one."""
+        now = time.monotonic()
+        if seq.record is not None:
+            seq.record.note_stall(now)
+        # pages to RE-ADMIT the folded sequence (+1 token headroom): if
+        # even an empty pool cannot hold it, recompute can never help
+        need_all = -(-(seq.num_tokens + 1) // self.page_size)
+        if seq.preempt_count >= self.PREEMPT_CAP \
+                or need_all > self.allocator.total_pages - 1:
+            self._note_finish(seq.request_id, "evict")
+            self._finish(slot, seq, finished)
+            return
+        seq.preempt_count += 1
+        self.stats["preemptions"] += 1
+        if seq.record is not None:
+            seq.record.note_preempt(now)
+        self._release_pages(seq.pages)
+        seq.pages = []
+        self._slots[slot] = None
+        self._page_table[slot, :] = SCRATCH_PAGE
+        seq.slot = None
+        seq.restore_generated = list(seq.generated)
+        seq.prompt = seq.prompt + seq.generated
+        seq.generated = []
+        seq.num_computed = 0
+        seq.cached_tokens = 0
+        seq.prefilling = False
+        with self._lock:
+            if seq in self.running:
+                self.running.remove(seq)
+            # waiting HEAD: preempted work has strictly the oldest
+            # enqueue_ts, and the aged-head admission guard keeps freed
+            # pages flowing to it first
+            self.waiting.insert(0, seq)
 
     # ----------------------------------------------------- pure decode
 
@@ -573,23 +684,33 @@ class InferenceEngine:
             jnp.asarray(self._positions), self.kv,
             jnp.asarray(self._page_table), jnp.asarray(seq_lens))
         block = np.asarray(toks_out)               # [K, B], ONE readback
+        now = time.monotonic()
         self.stats["decode_steps"] += K
         self.stats["decode_tokens"] += K * len(active)
         self.stats["decode_dispatches"] += 1
         for slot, seq in active:
+            n_new, fin = 0, False
             for j in range(K):
                 tok = int(block[j, slot])
                 if self.eos_token is not None and tok == self.eos_token:
                     self._note_finish(seq.request_id, "stop")
-                    self._finish(slot, seq, finished)
+                    fin = True
                     break
                 seq.generated.append(tok)
+                n_new += 1
                 if self.track_progress:
                     self._progress.setdefault(seq.request_id,
                                               []).append(tok)
                 if len(seq.generated) >= seq.max_new_tokens:
-                    self._finish(slot, seq, finished)
+                    fin = True
                     break
+            # ONE record entry per dispatch (the K-step loop is one
+            # device round trip — per-token host timestamps would be
+            # fiction), noted BEFORE _finish so e2e covers every token
+            if n_new and seq.record is not None:
+                seq.record.note_decode(now, n_new)
+            if fin:
+                self._finish(slot, seq, finished)
             else:
                 self._tokens[slot] = int(block[K - 1, slot])
                 self._positions[slot] = seq.num_tokens - 1
@@ -657,6 +778,11 @@ class InferenceEngine:
         if d_slots > 0:
             d_real = s["ragged_real_tokens"] - last["ragged_real_tokens"]
             self._g_pad_waste.set(1.0 - d_real / d_slots)
+        if self.request_log is not None:
+            a_ttft, a_tpot = self.request_log.slo_attainment()
+            self._g_slo_ttft.set(a_ttft)
+            self._g_slo_tpot.set(a_tpot)
+        self._g_preempts.set(float(s["preemptions"]))
         with self._lock:
             self._g_queue.set(len(self.waiting))
 

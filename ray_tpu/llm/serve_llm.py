@@ -24,6 +24,16 @@ from typing import Any, Dict, Iterator, List, Optional
 from ray_tpu.llm.engine import InferenceEngine
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.util import trace_context
+
+
+def _ambient_trace_id() -> str:
+    """The trace_id the serve router stamped on this request's wire
+    frame (restored as ambient context by the worker runtime) — linked
+    into the engine's flight-recorder record so `ray_tpu trace
+    --request <rid>` can merge span tree + request timeline."""
+    amb = trace_context.current()
+    return amb[0] if amb else ""
 
 
 class LLMServer:
@@ -83,7 +93,8 @@ class LLMServer:
         prompt = self._prompt_ids(request)
         max_tokens = int(request.get("max_tokens", 32))
         ev = threading.Event()
-        rid = self.engine.add_request(prompt, max_tokens)
+        rid = self.engine.add_request(prompt, max_tokens,
+                                      trace_id=_ambient_trace_id())
         with self._lock:
             self._events[rid] = ev
             if rid in self._results:  # engine already finished it
@@ -111,7 +122,8 @@ class LLMServer:
         max_tokens = int(request.get("max_tokens", 32))
         q: "queue_mod.Queue" = queue_mod.Queue()
         with self._lock:
-            rid = self.engine.add_request(prompt, max_tokens)
+            rid = self.engine.add_request(prompt, max_tokens,
+                                          trace_id=_ambient_trace_id())
             self._token_qs[rid] = q
         self._wake.set()
         produced: List[int] = []
@@ -299,6 +311,15 @@ class LLMServer:
                 "evictable_pages": prefix.num_evictable,
             }
         return out
+
+    def request_records(self) -> List[Dict[str, Any]]:
+        """Flight-recorder snapshot of this replica's engine (wire
+        dicts; [] when the recorder is disabled). The same records ship
+        to the head over telemetry_push — this is the direct,
+        replica-local view for tests and debugging."""
+        if self.engine.request_log is None:
+            return []
+        return self.engine.request_log.snapshot()
 
     def check_health(self) -> None:
         if not self._thread.is_alive():

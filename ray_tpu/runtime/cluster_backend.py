@@ -928,13 +928,21 @@ class ClusterBackend:
             wid12 = self.worker.worker_id.hex()[:12]
             for s in samples:
                 s.setdefault("tags", {})["worker"] = wid12
-            if snap or events or tracked or samples:
+            # LLM request records (llm/request_log.py flight recorders):
+            # drained only when some engine in this process already
+            # imported the module — resolved via sys.modules so
+            # non-serving workers never pull it in
+            reqlog = sys.modules.get("ray_tpu.llm.request_log")
+            llm_requests = reqlog.drain_all_exports() \
+                if reqlog is not None else []
+            if snap or events or tracked or samples or llm_requests:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
                     "node": self.local_node_id,
                     "metrics": snap, "events": events,
-                    "objects": objects, "samples": samples})
+                    "objects": objects, "samples": samples,
+                    "llm_requests": llm_requests})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 

@@ -315,6 +315,12 @@ class Head:
         from ray_tpu.util.timeseries import TimeSeriesStore
         self._timeseries = TimeSeriesStore(
             maxlen=cfg.timeseries_ring_points)
+        # LLM request records (llm/request_log.py flight recorders ship
+        # over telemetry_push): rid -> wire dict, bounded ring — the
+        # backing store for `python -m ray_tpu requests` / /api/requests
+        self._llm_requests: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._llm_requests_cap = max(2, cfg.llm_request_log_size)
         # unserviceable demand, deduped per (requester, shape): each
         # submitter polls its shape every ~0.2s, so per-poll appends would
         # over-count 25x per window (the autoscaler's demand signal;
@@ -354,6 +360,7 @@ class Head:
             "metrics_dump": self._h_metrics_dump,
             "timeline_dump": self._h_timeline_dump,
             "timeseries_dump": self._h_timeseries_dump,
+            "requests_dump": self._h_requests_dump,
             "autoscaler_state": self._h_autoscaler_state,
             "pubsub_publish": lambda p, c: self.pubsub.publish(
                 p["topic"], p["message"]),
@@ -1588,6 +1595,23 @@ class Head:
                 e["worker"] = p["worker"][:12]
                 e["node"] = p.get("node", "")
                 self._task_events.append(e)
+            for r in p.get("llm_requests", ()):
+                rid = r.get("rid")
+                if not rid:
+                    continue
+                # live snapshots re-ship every flush and overwrite; a
+                # landed FINISHED record is final — never let a stale
+                # in-flight snapshot (reordered flush) roll it back
+                cur = self._llm_requests.get(rid)
+                if cur is not None and cur.get("done") \
+                        and not r.get("done"):
+                    continue
+                r["worker"] = p["worker"][:12]
+                r["node"] = p.get("node", "")
+                self._llm_requests[rid] = r
+                self._llm_requests.move_to_end(rid)
+                while len(self._llm_requests) > self._llm_requests_cap:
+                    self._llm_requests.popitem(last=False)
         if p.get("samples"):
             # hardware gauges -> ring buffers (own lock; outside _lock so
             # a big batch never stalls lease/actor RPCs)
@@ -1629,6 +1653,24 @@ class Head:
     def _h_timeline_dump(self, p, ctx):
         with self._lock:
             return list(self._task_events)
+
+    def _h_requests_dump(self, p, ctx):
+        """LLM request records aggregated from engine flight recorders
+        (filters: live=True -> in-flight only; request=<rid> -> one
+        record; slowest=N -> N worst finished e2e latencies first)."""
+        p = p or {}
+        with self._lock:
+            recs = list(self._llm_requests.values())
+        rid = p.get("request")
+        if rid:
+            return [r for r in recs if r.get("rid") == rid]
+        if p.get("live"):
+            recs = [r for r in recs if not r.get("done")]
+        n = int(p.get("slowest", 0) or 0)
+        if n > 0:
+            recs = sorted(recs, key=lambda r: r.get("e2e") or 0.0,
+                          reverse=True)[:n]
+        return recs
 
     def _h_autoscaler_state(self, p, ctx):
         """Demand + per-node busyness for the autoscaler reconciler

@@ -13,6 +13,8 @@ Commands:
   status [--address H:P]                     cluster resources + nodes
   list {nodes,actors,workers,placement-groups,objects} [--address H:P]
   top [--watch] [--interval S]               node/worker hardware table
+  requests [--slowest N] [--live]            LLM request timelines
+  trace [--request RID | --trace-id T]       span tree / request timeline
   stop [--address H:P]                       stop node daemons + head
 """
 
@@ -168,6 +170,34 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _hist_quantile(metrics: dict, name: str, q: float):
+    """Quantile estimate from an aggregated histogram dump: counts sum
+    across tag values, the answer is the UPPER BOUND of the bucket the
+    quantile lands in (conservative; exact values aren't on the wire).
+    None when the histogram is absent or empty."""
+    m = metrics.get(name)
+    if not m or m.get("type") != "histogram" or not m.get("values"):
+        return None
+    bounds = list(m.get("boundaries") or ())
+    if not bounds:
+        return None
+    total = [0] * (len(bounds) + 1)
+    for v in m["values"].values():
+        for i, c in enumerate(v.get("counts") or ()):
+            if i < len(total):
+                total[i] += c
+    n = sum(total)
+    if n == 0:
+        return None
+    run = 0
+    for i, c in enumerate(total):
+        run += c
+        if run >= q * n:
+            # +Inf bucket: report the largest finite bound we know
+            return bounds[min(i, len(bounds) - 1)]
+    return bounds[-1]
+
+
 def _fmt_bytes(n: float) -> str:
     n = float(n)
     for unit in ("B", "KiB", "MiB", "GiB"):
@@ -216,6 +246,12 @@ def _render_top(client, address: str) -> str:
         vals = metrics.get(name, {}).get("values", {})
         return sum(vals.values()) if vals else None
 
+    def _gauge_mean(name):
+        # fraction-valued gauges (SLO attainment) MEAN across workers —
+        # summing fractions over engines would overshoot 1.0
+        vals = metrics.get(name, {}).get("values", {})
+        return sum(vals.values()) / len(vals) if vals else None
+
     # LLM engine gauges (present when an InferenceEngine runs anywhere
     # on the cluster): one summary line mirroring what vLLM logs per step
     llm_decode = _gauge("llm_decode_tokens_per_s")
@@ -228,6 +264,21 @@ def _render_top(client, address: str) -> str:
         llm_line = (f"llm: decode {llm_decode:.0f} tok/s  "
                     f"prefill {pf:.0f} tok/s  kv_util {kv:.0%}  "
                     f"prefix_hit {hit:.0%}  queued {lq:g}")
+        # request-level serving latencies from the flight-recorder
+        # histograms (bucket upper bounds, hence the <=)
+        ttft50 = _hist_quantile(metrics, "llm_ttft_seconds", 0.5)
+        ttft99 = _hist_quantile(metrics, "llm_ttft_seconds", 0.99)
+        tpot50 = _hist_quantile(metrics, "llm_tpot_seconds", 0.5)
+        if ttft50 is not None and ttft99 is not None:
+            llm_line += (f"  ttft p50<={ttft50 * 1e3:.0f}ms "
+                         f"p99<={ttft99 * 1e3:.0f}ms")
+        if tpot50 is not None:
+            llm_line += f"  tpot p50<={tpot50 * 1e3:.1f}ms"
+        slo_ttft = _gauge_mean("llm_slo_ttft_attainment")
+        slo_tpot = _gauge_mean("llm_slo_tpot_attainment")
+        if slo_ttft is not None and slo_tpot is not None:
+            llm_line += (f"  slo ttft {slo_ttft:.0%} "
+                         f"tpot {slo_tpot:.0%}")
     nodes = dump["nodes"]
     alive = [n for n in nodes if n["alive"]]
     lines = [
@@ -301,6 +352,101 @@ def cmd_top(args) -> int:
         return 0
 
 
+def _fmt_ms(v) -> str:
+    return f"{v * 1e3:.1f}ms" if v is not None else "-"
+
+
+def format_request_timeline(r: dict, indent: str = "") -> str:
+    """Render one flight-recorder record (wire dict) as a lifecycle
+    timeline: enqueue -> admit (queue wait, cached tokens) -> prefill
+    chunks -> first token (TTFT) -> decode -> finish reason."""
+    p = indent
+    where = ""
+    if r.get("worker") or r.get("node"):
+        where = f"  @{r.get('worker', '')}" \
+                + (f"/{r['node'][:12]}" if r.get("node") else "")
+    trace = f"  trace {r['trace_id']}" if r.get("trace_id") else ""
+    status = r.get("finish_reason") or "in-flight"
+    lines = [f"{p}{r.get('rid', '?')}  [{status}]{where}{trace}"]
+    lines.append(f"{p}  enqueue   +0.0ms  "
+                 f"(prompt {r.get('prompt_tokens', 0)} tok, "
+                 f"max_new {r.get('max_new_tokens', 0)})")
+    admits = r.get("admits") or []
+    for i, (ts, cached) in enumerate(admits):
+        tag = "" if len(admits) == 1 else f" #{i + 1}"
+        lines.append(f"{p}  admit{tag}     +{ts * 1e3:.1f}ms  "
+                     f"(queue wait {_fmt_ms(r.get('queue_wait')) if i == 0 else _fmt_ms(ts)}, "
+                     f"cached {cached} tok)")
+    chunks = r.get("chunks") or []
+    if chunks:
+        toks = "+".join(str(c[1]) for c in chunks[:8]) \
+            + ("+..." if len(chunks) > 8 else "")
+        disp = sorted({c[2] for c in chunks})
+        disp_s = f"{disp[0]}..{disp[-1]}" if len(disp) > 1 else f"{disp[0]}"
+        lines.append(f"{p}  prefill   {len(chunks)} chunk(s) "
+                     f"[{toks} tok]  dispatch {disp_s}  "
+                     f"last +{chunks[-1][0] * 1e3:.1f}ms")
+    if r.get("ttft") is not None:
+        lines.append(f"{p}  first tok +{r['ttft'] * 1e3:.1f}ms  (TTFT)")
+    n_out = r.get("n_generated", 0)
+    if n_out > 1 and r.get("tpot"):
+        tpot = r["tpot"]
+        lines.append(f"{p}  decode    {n_out} tok in "
+                     f"{len(r.get('decode') or [])} dispatch(es)  "
+                     f"tpot {tpot * 1e3:.2f}ms  "
+                     f"({1.0 / tpot:.0f} tok/s)")
+    extras = []
+    if r.get("stalls"):
+        extras.append(f"stalls {r['stalls']}")
+    if r.get("preempts"):
+        extras.append(f"preempts {r['preempts']} "
+                      f"(at {', '.join(f'+{t * 1e3:.1f}ms' for t in r.get('preempt_ts', []))})")
+    if extras:
+        lines.append(f"{p}  pressure  " + "  ".join(extras))
+    if r.get("e2e") is not None:
+        lines.append(f"{p}  finish    +{r['e2e'] * 1e3:.1f}ms  "
+                     f"reason={r.get('finish_reason')}")
+    return "\n".join(lines)
+
+
+def _render_requests(client, args) -> str:
+    payload = {"slowest": int(getattr(args, "slowest", 0) or 0)}
+    recs = client.call("requests_dump", payload, timeout=10)
+    if not recs:
+        return ("no request records at the head (engines flush every "
+                "metrics_export_period_s; is the recorder enabled?)")
+    if getattr(args, "format", "plain") == "json":
+        return json.dumps(recs, indent=2, default=str)
+    head = "slowest " if payload["slowest"] else ""
+    out = [f"{len(recs)} {head}request(s)", ""]
+    out += [format_request_timeline(r) + "\n" for r in recs]
+    return "\n".join(out).rstrip("\n")
+
+
+def cmd_requests(args) -> int:
+    """Per-request serving timelines from the engines' flight recorders,
+    aggregated at the head (requests_dump RPC over telemetry_push)."""
+    address = load_address(args.address)
+    client = _client(address)
+    if not args.live:
+        print(_render_requests(client, args))
+        return 0
+    frames = args.frames  # hidden test hook: bounded repaint count
+    try:
+        while True:
+            frame = _render_requests(client, args)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            if frames is not None:
+                frames -= 1
+                if frames <= 0:
+                    break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_timeline(args) -> int:
     from ray_tpu.runtime.events import to_chrome_trace
     address = load_address(args.address)
@@ -334,7 +480,34 @@ def cmd_trace(args) -> int:
     it as an indented span tree (or JSON)."""
     from ray_tpu.util.tracing import assemble_trace, latest_train_step
     address = load_address(args.address)
-    events = _client(address).call("timeline_dump")
+    client = _client(address)
+    events = client.call("timeline_dump")
+    if getattr(args, "request", ""):
+        # merged view for one LLM request: the router/replica span tree
+        # (via the trace_id the record carries) + the engine's
+        # flight-recorder timeline under it
+        recs = client.call("requests_dump", {"request": args.request},
+                           timeout=10)
+        if not recs:
+            print(f"no request record for {args.request!r} (records "
+                  "reach the head on the engine worker's next telemetry "
+                  "flush)", file=sys.stderr)
+            return 1
+        rec = recs[0]
+        tid = rec.get("trace_id") or args.trace_id
+        roots = assemble_trace(events, trace_id=tid) if tid else []
+        if args.format == "json":
+            print(json.dumps({"record": rec, "spans": roots},
+                             indent=2, default=str))
+            return 0
+        print(f"request {rec['rid']}  trace {tid or '-'}")
+        for r in roots:
+            _show_span(r, 1)
+        if not roots:
+            print("  (no spans for this trace yet — the router's "
+                  "telemetry flush may still be pending)")
+        print(format_request_timeline(rec, indent="  "))
+        return 0
     if getattr(args, "train_step", False):
         step = latest_train_step(events)
         if step is None:
@@ -356,16 +529,6 @@ def cmd_trace(args) -> int:
         return 0
     print(f"trace {roots[0]['trace_id']}")
 
-    def show(span, depth):
-        dur_ms = max(0.0, span["end"] - span["start"]) * 1e3
-        mark = "" if span.get("ok", True) else "  [FAILED]"
-        where = span.get("worker", "")
-        where = f" @{where}" if where else ""
-        print(f"{'  ' * depth}- {span['name']}  {dur_ms:.2f}ms"
-              f"{where}{mark}  span={span['span_id']}")
-        for c in span["children"]:
-            show(c, depth + 1)
-
     n = 0
 
     def count(span):
@@ -374,10 +537,21 @@ def cmd_trace(args) -> int:
         for c in span["children"]:
             count(c)
     for r in roots:
-        show(r, 0)
+        _show_span(r, 0)
         count(r)
     print(f"({n} spans)", file=sys.stderr)
     return 0
+
+
+def _show_span(span, depth) -> None:
+    dur_ms = max(0.0, span["end"] - span["start"]) * 1e3
+    mark = "" if span.get("ok", True) else "  [FAILED]"
+    where = span.get("worker", "")
+    where = f" @{where}" if where else ""
+    print(f"{'  ' * depth}- {span['name']}  {dur_ms:.2f}ms"
+          f"{where}{mark}  span={span['span_id']}")
+    for c in span["children"]:
+        _show_span(c, depth + 1)
 
 
 def cmd_dashboard(args) -> int:
@@ -460,8 +634,27 @@ def main(argv=None) -> int:
     sp.add_argument("--train-step", action="store_true",
                     help="show the latest profiled train step's phase "
                          "breakdown (train.profile_train_step)")
+    sp.add_argument("--request", default="",
+                    help="merged timeline for one LLM request id: router/"
+                         "replica spans + the engine's flight-recorder "
+                         "lifecycle events")
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("requests",
+                        help="per-request LLM serving timelines (queue "
+                             "wait, prefill chunks, TTFT, decode tok/s, "
+                             "finish reason)")
+    sp.add_argument("--address")
+    sp.add_argument("--slowest", type=int, default=0,
+                    help="only the N worst end-to-end latencies")
+    sp.add_argument("--live", action="store_true",
+                    help="repaint continuously until ctrl-c")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--frames", type=int, default=None,
+                    help=argparse.SUPPRESS)  # test hook: bounded repaints
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_requests)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     sp.add_argument("--address")

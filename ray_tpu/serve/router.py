@@ -137,20 +137,33 @@ class DeploymentResponse:
     replica-scheduler failover, moved to result time because submission
     here never fails synchronously."""
 
-    def __init__(self, ref, retry=None):
+    def __init__(self, ref, retry=None, note=None):
         self._ref = ref
         self._retry = retry
+        # note(outcome): router latency observation for non-ok endings
+        # ("timeout"/"retry"/"error") — the ok path is observed by the
+        # router's reaper when the reply lands, so without this the
+        # latency histogram silently excluded exactly the worst requests
+        self._note = note if note is not None else (lambda outcome: None)
 
     def result(self, timeout: Optional[float] = 30.0) -> Any:
-        from ray_tpu.exceptions import ActorError
+        from ray_tpu.exceptions import ActorError, GetTimeoutError
         attempts = 3
         while True:
             try:
                 return ray_tpu.get(self._ref, timeout=timeout)
+            except GetTimeoutError:
+                # the replica may still complete later (the reaper then
+                # observes outcome="ok" for the landed reply); this
+                # sample records that the CALLER gave up at `timeout`
+                self._note("timeout")
+                raise
             except ActorError:
                 attempts -= 1
                 if self._retry is None or attempts <= 0:
+                    self._note("error")
                     raise
+                self._note("retry")
                 self._ref = self._retry()
 
     @property
@@ -165,33 +178,47 @@ class DeploymentResponseGenerator:
     value; the router's in-flight count for the replica is released once,
     when the stream ends (or this wrapper is dropped)."""
 
-    def __init__(self, ref_gen, on_done, retry=None):
+    def __init__(self, ref_gen, on_done, retry=None, note=None):
         self._gen = ref_gen
         self._on_done = on_done
         self._done = False
         self._retry = retry
         self._yielded = False
+        # note(outcome): first call wins (router-side latch) — error
+        # paths stamp their outcome BEFORE _finish's default "ok"
+        self._note = note if note is not None else (lambda outcome: None)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        from ray_tpu.exceptions import ActorError
+        from ray_tpu.exceptions import ActorError, GetTimeoutError
         try:
             ref = next(self._gen)
             value = ray_tpu.get(ref, timeout=300)
+        except StopIteration:
+            self._finish()          # stream end: observes outcome="ok"
+            raise
+        except GetTimeoutError:
+            self._note("timeout")
+            self._finish()
+            raise
         except ActorError:
             # replica died BEFORE producing anything: safe to re-route
             # (once items flowed, replaying could duplicate side effects)
             if self._yielded or self._retry is None:
+                self._note("error")
                 self._finish()
                 raise
+            self._note("retry")
             self._finish()
             fresh = self._retry()
             self._gen, self._on_done = fresh._gen, fresh._on_done
+            self._note = fresh._note
             self._done, self._retry = False, None
             return next(self)
         except BaseException:
+            self._note("error")
             self._finish()
             raise
         self._yielded = True
@@ -316,16 +343,20 @@ class Router:
                     seen.pop(min(seen, key=seen.get))
             return chosen
 
-    def _note_metrics(self, latency_s: float = -1.0) -> None:
+    def _note_metrics(self, latency_s: float = -1.0,
+                      outcome: str = "ok") -> None:
         """Built-in serve metrics (L5 source wiring): the inflight gauge
         tracks this router's total outstanding count; completions observe
-        the per-deployment latency histogram. Registered lazily and
+        the per-deployment latency histogram, tagged with the request
+        outcome (ok/timeout/retry/error) so p99 includes the worst cases
+        instead of silently excluding them. Registered lazily and
         swallowed on failure — routing must never depend on telemetry."""
         try:
             from ray_tpu.util import metrics as metrics_mod
-            tags = {"deployment": self._name}
+            tags = {"deployment": self._name, "outcome": outcome}
             with self._lock:
                 total = sum(self._inflight.values())
+            # the gauge's tag_keys filter drops the outcome key
             metrics_mod.serve_inflight_gauge().set(total, tags=tags)
             if latency_s >= 0:
                 metrics_mod.serve_request_latency_histogram().observe(
@@ -352,11 +383,22 @@ class Router:
             self._inflight[key] = self._inflight.get(key, 0) + 1
         self._note_metrics()
         t0 = time.monotonic()
+        observed = [False]
+
+        def note(outcome: str) -> None:
+            # one latency observation per attempt: timeout/retry/error
+            # paths stamp their outcome first; stream end lands "ok"
+            if observed[0]:
+                return
+            observed[0] = True
+            self._note_metrics(latency_s=time.monotonic() - t0,
+                               outcome=outcome)
 
         def done():
             with self._lock:
                 self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
-            self._note_metrics(latency_s=time.monotonic() - t0)
+            note("ok")
+            self._note_metrics()
         try:
             gen = self._traced_remote(
                 method_name,
@@ -364,6 +406,7 @@ class Router:
                     num_returns="streaming").remote(
                         method_name, args, kwargs))
         except BaseException:
+            note("error")
             done()
             raise
 
@@ -372,17 +415,25 @@ class Router:
             self._refresh(force=True)
             return self.route_streaming(method_name, args,
                                         dict(kwargs), model_id)
-        return DeploymentResponseGenerator(gen, done, retry=retry)
+        return DeploymentResponseGenerator(gen, done, retry=retry,
+                                           note=note)
 
     def route(self, method_name: str, args: tuple, kwargs: dict,
               model_id: str = "") -> DeploymentResponse:
+        t0 = time.monotonic()
         ref = self._submit(method_name, args, kwargs, model_id)
 
         def retry():
             # replica died before replying: refetch the table and resubmit
             self._refresh(force=True)
             return self._submit(method_name, args, kwargs, model_id)
-        return DeploymentResponse(ref, retry=retry)
+
+        def note(outcome: str) -> None:
+            # non-ok endings seen at result() time; the ok path is
+            # observed by the reaper when the reply lands
+            self._note_metrics(latency_s=time.monotonic() - t0,
+                               outcome=outcome)
+        return DeploymentResponse(ref, retry=retry, note=note)
 
     def _traced_remote(self, method_name: str, submit):
         """Run one replica submit under a router span: joins the caller's

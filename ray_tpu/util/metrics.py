@@ -190,11 +190,14 @@ def queue_depth_gauge() -> Gauge:
 def serve_request_latency_histogram() -> Histogram:
     """Per-deployment request latency, submit at the router to reply
     landed (reference: serve_deployment_processing_latency_ms — here in
-    seconds, observed caller-side so it includes queueing + transport)."""
+    seconds, observed caller-side so it includes queueing + transport).
+    Tagged with the request outcome (ok/timeout/retry/error) so p99
+    stops silently excluding the worst cases: timed-out and retried
+    requests observe too."""
     return Histogram(
         "serve_request_latency_s",
         description="seconds from router submit to replica reply",
-        tag_keys=("deployment",))
+        tag_keys=("deployment", "outcome"))
 
 
 def serve_inflight_gauge() -> Gauge:
@@ -304,6 +307,76 @@ def llm_padding_waste_gauge() -> Gauge:
     return Gauge("llm_ragged_padding_waste",
                  description="padding fraction of ragged step token "
                              "slots (0..1)")
+
+
+# Serving-latency buckets: sub-ms (cache hit / queue-free admit) up to
+# 30s (page-pressure starvation); TPOT gets a finer low end, e2e a
+# longer tail. vLLM exposes the same trio of request histograms.
+_LLM_LATENCY_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+_LLM_TPOT_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.075, 0.1, 0.25, 0.5, 1.0)
+_LLM_E2E_BOUNDS = _LLM_LATENCY_BOUNDS + (60.0, 120.0)
+
+
+def llm_ttft_seconds_histogram() -> Histogram:
+    """Time to first token: enqueue at the engine to the first sampled
+    token (queue wait + prefill), per finished request."""
+    return Histogram("llm_ttft_seconds",
+                     description="seconds from request enqueue to first "
+                                 "generated token",
+                     boundaries=_LLM_LATENCY_BOUNDS)
+
+
+def llm_tpot_seconds_histogram() -> Histogram:
+    """Time per output token after the first: (last_token_ts -
+    first_token_ts) / (n_generated - 1), the mean inter-token latency of
+    a finished request (vLLM TPOT)."""
+    return Histogram("llm_tpot_seconds",
+                     description="mean seconds per output token after "
+                                 "the first",
+                     boundaries=_LLM_TPOT_BOUNDS)
+
+
+def llm_e2e_seconds_histogram() -> Histogram:
+    """End-to-end request latency: enqueue to finish."""
+    return Histogram("llm_e2e_seconds",
+                     description="seconds from request enqueue to finish",
+                     boundaries=_LLM_E2E_BOUNDS)
+
+
+def llm_queue_wait_seconds_histogram() -> Histogram:
+    """Admission queue wait: enqueue to first slot admission."""
+    return Histogram("llm_queue_wait_seconds",
+                     description="seconds from request enqueue to "
+                                 "admission into a batch slot",
+                     boundaries=_LLM_LATENCY_BOUNDS)
+
+
+def llm_slo_ttft_attainment_gauge() -> Gauge:
+    """Fraction of finished requests whose TTFT met the configured
+    llm_slo_ttft_ms target (1.0 until a request finishes)."""
+    return Gauge("llm_slo_ttft_attainment",
+                 description="fraction of requests meeting the TTFT SLO "
+                             "(0..1)")
+
+
+def llm_slo_tpot_attainment_gauge() -> Gauge:
+    """Fraction of finished requests whose TPOT met the configured
+    llm_slo_tpot_ms target (single-token requests count as met)."""
+    return Gauge("llm_slo_tpot_attainment",
+                 description="fraction of requests meeting the TPOT SLO "
+                             "(0..1)")
+
+
+def llm_preemptions_gauge() -> Gauge:
+    """Cumulative decode preemptions (sequences that lost their pages
+    under allocation pressure and re-queued for recompute) — vLLM's
+    num_preemptions counter; sustained growth says the KV pool is
+    undersized for the workload."""
+    return Gauge("llm_preemptions_total",
+                 description="cumulative decode preemptions (recompute "
+                             "re-queues)")
 
 
 def tune_running_trials_gauge() -> Gauge:

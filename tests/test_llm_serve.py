@@ -2,6 +2,8 @@
 continuous batching across concurrent requests (reference capability:
 ray.serve.llm LLMDeployment over vLLM)."""
 
+import time
+
 import pytest
 
 import ray_tpu as rt
@@ -11,9 +13,11 @@ from ray_tpu import serve
 @pytest.fixture(scope="module")
 def serve_rt():
     # 8 TPU resources let the tp>1 deployment's derived {"TPU": tp} gang
-    # reservation schedule on the test cluster
+    # reservation schedule on the test cluster; the fast telemetry period
+    # lets the flight-recorder head-aggregation test poll quickly
     rt.init(num_cpus=4, resources={"TPU": 8}, _system_config={
         "object_store_memory_bytes": 128 * 1024 * 1024,
+        "metrics_export_period_s": 1.0,
     })
     yield rt
     serve.shutdown()
@@ -39,6 +43,55 @@ def test_llm_deployment_concurrent_requests(serve_rt):
     stats = h.stats.remote().result(timeout=60)
     # continuous batching + chunking: 18 tokens in a handful of dispatches
     assert stats["decode_dispatches"] < 9, stats
+
+
+def test_llm_request_record_links_router_trace(serve_rt):
+    """Acceptance: the trace_id the serve router stamps on the wire is
+    the one in the engine's flight-recorder record, and the record ships
+    to the head (requests_dump) over the telemetry plane."""
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.llm import LLMServer
+    from ray_tpu.util import trace_context
+
+    dep = serve.deployment(name="llm-obs", max_ongoing_requests=8)(
+        LLMServer)
+    h = serve.run(dep.bind(
+        {"n_layers": 2},
+        {"page_size": 8, "total_pages": 64, "max_batch": 4,
+         "max_seq_len": 128, "seed": 7},
+    ), timeout_s=240)
+
+    tid = trace_context.new_trace_id()
+    tok = trace_context.activate(tid, trace_context.new_span_id())
+    try:
+        out = h.remote({"prompt_ids": [5, 17, 42, 9],
+                        "max_tokens": 4}).result(timeout=300)
+    finally:
+        trace_context.deactivate(tok)
+    rid = out["request_id"]
+
+    # replica-local view: the record carries the ROUTER's trace_id
+    recs = h.request_records.remote().result(timeout=60)
+    rec = {r["rid"]: r for r in recs}[rid]
+    assert rec["trace_id"] == tid
+    assert rec["done"] and rec["finish_reason"] == "length"
+    assert rec["n_generated"] == 4 and rec["ttft"] > 0
+
+    # head-side view: telemetry_push ships the finished record
+    head = global_worker.backend.head
+    deadline = time.monotonic() + 60
+    got = []
+    while time.monotonic() < deadline:
+        got = head.call("requests_dump", {"request": rid}, timeout=10)
+        if got and got[0].get("done"):
+            break
+        time.sleep(0.5)
+    assert got, "record never reached the head"
+    assert got[0]["rid"] == rid and got[0]["trace_id"] == tid
+    assert got[0]["worker"] and got[0]["node"]
+    slowest = head.call("requests_dump", {"slowest": 5}, timeout=10)
+    assert any(r["rid"] == rid for r in slowest)
+    serve.delete("llm-obs")
 
 
 def test_llm_tp_deployment_gang_resources(serve_rt):

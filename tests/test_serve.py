@@ -56,6 +56,40 @@ def test_class_deployment_and_methods(serve_rt):
     assert h.shout.remote("tpu").result(timeout=30) == "hello tpu!!"
 
 
+def test_request_latency_outcome_tags(serve_rt):
+    """Timed-out requests must OBSERVE into the latency histogram with
+    outcome="timeout" (previously they never observed, so p99 silently
+    excluded the worst requests); completed ones land outcome="ok"."""
+    from ray_tpu.exceptions import GetTimeoutError
+    from ray_tpu.util import metrics as metrics_mod
+
+    @serve.deployment(name="lagger", num_replicas=1)
+    def lagger(delay_s):
+        time.sleep(delay_s)
+        return delay_s
+
+    h = serve.run(lagger.bind())
+    assert h.remote(0.0).result(timeout=60) == 0.0
+    with pytest.raises(GetTimeoutError):
+        h.remote(8.0).result(timeout=0.5)
+
+    def outcomes():
+        fam = metrics_mod.snapshot().get("serve_request_latency_s", {})
+        return {key: hist["n"] for key, hist in
+                fam.get("values", {}).items() if key[0] == "lagger"}
+
+    # the timeout observes synchronously at result() time; the ok path
+    # observes from the reaper thread when the reply lands
+    assert outcomes().get(("lagger", "timeout"), 0) >= 1, outcomes()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if outcomes().get(("lagger", "ok"), 0) >= 1:
+            break
+        time.sleep(0.2)
+    assert outcomes().get(("lagger", "ok"), 0) >= 1, outcomes()
+    serve.delete("lagger")
+
+
 def test_multi_replica_routing(serve_rt):
     @serve.deployment(num_replicas=2, max_ongoing_requests=4)
     class PidSvc:

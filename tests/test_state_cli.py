@@ -1,6 +1,7 @@
 """State API + CLI tests (reference coverage model:
 python/ray/tests/test_state_api.py + CLI smoke in test_cli.py)."""
 
+import json
 import subprocess
 import sys
 import uuid
@@ -44,6 +45,182 @@ def test_state_api_lists(state_rt):
     assert any(x["name"].endswith(name) for x in actors)
     s = state.summarize()
     assert s["nodes_alive"] == 1 and s["actors_alive"] >= 1
+
+
+def test_hist_quantile_and_top_llm_line():
+    """`top` derives TTFT/TPOT quantiles from the aggregated serving
+    histograms (bucket upper bounds) and MEANS the SLO-attainment gauges
+    across workers instead of summing fractions."""
+    from ray_tpu.scripts import cli
+
+    metrics = {
+        "llm_ttft_seconds": {
+            "type": "histogram", "boundaries": (0.01, 0.05, 0.1),
+            "values": {
+                "a": {"counts": [6, 2, 1, 1], "sum": 0.3, "n": 10},
+                "b": {"counts": [4, 1, 0, 0], "sum": 0.05, "n": 5}}},
+    }
+    # counts sum ACROSS tag values: totals [10, 3, 1, +Inf 1], n=15
+    assert cli._hist_quantile(metrics, "llm_ttft_seconds", 0.5) == 0.01
+    # p99 lands in +Inf: report the largest finite bound
+    assert cli._hist_quantile(metrics, "llm_ttft_seconds", 0.99) == 0.1
+    assert cli._hist_quantile(metrics, "absent", 0.5) is None
+    assert cli._hist_quantile(
+        {"llm_ttft_seconds": {"type": "histogram", "boundaries": (1.0,),
+                              "values": {}}},
+        "llm_ttft_seconds", 0.5) is None
+
+    metrics.update({
+        "llm_tpot_seconds": {
+            "type": "histogram", "boundaries": (0.005, 0.01),
+            "values": {"a": {"counts": [3, 1, 0], "sum": 0.02, "n": 4}}},
+        "llm_decode_tokens_per_s": {"type": "gauge",
+                                    "values": {"w0": 120.0}},
+        "llm_slo_ttft_attainment": {"type": "gauge",
+                                    "values": {"w0": 0.9, "w1": 0.7}},
+        "llm_slo_tpot_attainment": {"type": "gauge",
+                                    "values": {"w0": 1.0, "w1": 0.5}},
+    })
+
+    class FakeClient:
+        def call(self, op, payload=None, timeout=None):
+            if op == "state_dump":
+                return {"nodes": [{"node_id": "n" * 32, "alive": True}],
+                        "leases": 0}
+            if op == "timeseries_dump":
+                return []
+            if op == "metrics_dump":
+                return metrics
+            raise AssertionError(op)
+
+    out = cli._render_top(FakeClient(), "127.0.0.1:1")
+    assert "llm: decode 120 tok/s" in out
+    assert "ttft p50<=10ms p99<=100ms" in out
+    assert "tpot p50<=5.0ms" in out
+    assert "slo ttft 80% tpot 75%" in out  # mean, not sum
+
+
+def _seed_request_records(probe, trace_id):
+    """Push two finished flight-recorder records (built by the REAL
+    recorder, so the wire shape is authentic) + the router span of the
+    slow one's trace into the head's telemetry tables."""
+    import time as time_mod
+
+    from ray_tpu.llm.request_log import FlightRecorder
+
+    fr = FlightRecorder(capacity=8, observe_metrics=False)
+    fast = fr.start("req-clifast-0", 8, 4, trace_id="")
+    fast.note_admit(fast.t0 + 0.001, 0)
+    fast.note_chunk(fast.t0 + 0.003, 8, 11)
+    t = fast.t0 + 0.005
+    fast.note_decode(t, 1)
+    for _ in range(3):
+        t += 0.002
+        fast.note_decode(t, 1)
+    fr.finish(fast, t + 0.001, "length")
+
+    slow = fr.start("req-clislow-0", 16, 8, trace_id=trace_id)
+    slow.note_admit(slow.t0 + 0.010, 4)
+    slow.note_chunk(slow.t0 + 0.040, 16, 12)
+    slow.note_stall(slow.t0 + 0.050)
+    slow.note_preempt(slow.t0 + 0.055)
+    slow.note_admit(slow.t0 + 0.060, 0)
+    t = slow.t0 + 0.100
+    slow.note_decode(t, 1)
+    for _ in range(7):
+        t += 0.020
+        slow.note_decode(t, 1)
+    fr.finish(slow, t + 0.001, "stop")
+
+    now = time_mod.time()
+    probe.call("telemetry_push", {
+        "worker": "cliworker" + "0" * 23, "node": "clinode" + "0" * 25,
+        "llm_requests": fr.drain_export(),
+        "events": [{"name": "serve.router::llm.__call__",
+                    "kind": "serve_router", "task_id": "",
+                    "start": now - 0.2, "end": now, "ok": True,
+                    "trace_id": trace_id, "span_id": "a1" * 8,
+                    "parent_span_id": ""}],
+    }, timeout=10)
+
+
+def test_requests_cli_and_trace_request_merge(state_rt):
+    """`requests` renders per-request timelines from the head's
+    aggregated flight-recorder records; `--slowest N` ranks by e2e;
+    `trace --request RID` merges the router span tree with the record's
+    timeline (acceptance: trace-linked request view end-to-end)."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    address = global_worker.backend.head_addr
+    trace_id = "feedc0de" * 4
+    _seed_request_records(global_worker.backend.head, trace_id)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["requests", "--address", address]) == 0
+    out = buf.getvalue()
+    assert "req-clifast-0" in out and "req-clislow-0" in out
+    assert "(TTFT)" in out and "enqueue" in out and "tpot" in out
+    assert "reason=length" in out and "reason=stop" in out
+    # the preempted record shows BOTH phases + the pressure line
+    assert "admit #1" in out and "admit #2" in out
+    assert "preempts 1" in out and "stalls" in out
+    assert "@cliworker" in out
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["requests", "--slowest", "1",
+                         "--address", address]) == 0
+    out = buf.getvalue()
+    assert "req-clislow-0" in out and "req-clifast-0" not in out
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["requests", "--format", "json",
+                         "--address", address]) == 0
+    rows = json.loads(buf.getvalue())
+    by_rid = {r["rid"]: r for r in rows}
+    assert by_rid["req-clislow-0"]["trace_id"] == trace_id
+    assert by_rid["req-clislow-0"]["preempts"] == 1
+
+    # merged trace view: span tree + timeline in one rendering
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["trace", "--request", "req-clislow-0",
+                         "--address", address]) == 0
+    out = buf.getvalue()
+    assert f"request req-clislow-0  trace {trace_id}" in out
+    assert "serve.router::llm.__call__" in out  # the linked span tree
+    assert "first tok" in out and "reason=stop" in out
+
+    # unknown rid: exit 1 with a hint on stderr
+    assert cli.main(["trace", "--request", "req-missing",
+                     "--address", address]) == 1
+
+
+@pytest.mark.slow
+def test_requests_cli_live_watch(state_rt):
+    """`requests --live` repaints until interrupted; the hidden --frames
+    hook bounds the loop for tests."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    address = global_worker.backend.head_addr
+    _seed_request_records(global_worker.backend.head, "ab" * 16)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["requests", "--live", "--interval", "0.1",
+                         "--frames", "2", "--address", address]) == 0
+    out = buf.getvalue()
+    assert out.count("\x1b[2J") == 2  # two repaints, then exit
+    assert "req-clifast-0" in out
 
 
 def test_cli_status_and_list(state_rt):

@@ -204,6 +204,35 @@ def test_prometheus_exposition_golden_round_trip():
         "serve_latency__s_"
 
 
+def test_prometheus_histogram_tag_escaping_round_trip():
+    """Histogram TAG values with every escape-worthy character survive
+    render -> parse intact on bucket/sum/count lines alike (the serving
+    histograms carry deployment/outcome tags from user-chosen names)."""
+    metrics_mod.clear_registry()
+    nasty_dep = 'llm "v2"\\canary\nblue'
+    nasty_out = 'time\\out "hard"'
+    try:
+        h = metrics_mod.Histogram(
+            "probe_latency_seconds", description="escape probe",
+            boundaries=(0.1, 1.0), tag_keys=("deployment", "outcome"))
+        h.observe(0.05, tags={"deployment": nasty_dep,
+                              "outcome": nasty_out})
+        h.observe(5.0, tags={"deployment": nasty_dep,
+                             "outcome": nasty_out})
+        agg = metrics_mod.aggregate({"w0": metrics_mod.snapshot()})
+    finally:
+        metrics_mod.clear_registry()
+    fams = prometheus.parse(prometheus.render(agg))
+    samples = fams["probe_latency_seconds"]["samples"]
+    assert samples, fams
+    for name, labels, _ in samples:
+        assert labels["deployment"] == nasty_dep, (name, labels)
+        assert labels["outcome"] == nasty_out, (name, labels)
+    buckets = {s[1]["le"]: s[2] for s in samples
+               if s[0] == "probe_latency_seconds_bucket"}
+    assert buckets == {"0.1": 1.0, "1": 1.0, "+Inf": 2.0}
+
+
 # ------------------------------------------------- live cluster surfaces
 
 @pytest.fixture(scope="module")
