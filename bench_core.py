@@ -171,6 +171,14 @@ class Actor:
     def small_value_batch(self, n):
         ray_tpu.get([small_value.remote() for _ in range(n)])
 
+    def put_get_batch(self, n, nbytes):
+        # shm-path put/get loop (above the 100KiB inline cutoff): each
+        # round trips seal + directory record + pin bookkeeping; the ref
+        # drops at loop end so the arena never accumulates
+        blob = b"x" * nbytes
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(blob))
+
 
 @ray_tpu.remote(num_cpus=0)
 class AsyncActor:
@@ -243,6 +251,26 @@ def main() -> None:
            lambda: ray_tpu.get(
                [do_put_large.remote(8 * 1024 * 1024 * 8) for _ in range(8)]),
            multiplier=8 * per_task)
+
+    # object-plane accounting overhead A/B (acceptance for the
+    # observability PR: <2% on shm put/get): the SAME 1 MiB put/get
+    # batch inside a worker with the object directory + spill/pull
+    # counters enabled (default) vs disabled via env override.
+    # ab_vs_degraded is on/off — >= 0.98 means the bookkeeping costs
+    # under 2%.
+    acct_on = Actor.remote()
+    acct_off = Actor.options(runtime_env={
+        "env_vars": {"RTPU_object_accounting": "0"}}).remote()
+    ray_tpu.get([acct_on.put_get_batch.remote(4, 1 << 20),
+                 acct_off.put_get_batch.remote(4, 1 << 20)])
+    timeit_ab("object_accounting_put_get",
+              lambda: ray_tpu.get(
+                  acct_on.put_get_batch.remote(50, 1 << 20)),
+              lambda: ray_tpu.get(
+                  acct_off.put_get_batch.remote(50, 1 << 20)),
+              multiplier=50)
+    ray_tpu.kill(acct_on)
+    ray_tpu.kill(acct_off)
 
     timeit("single_client_tasks_sync",
            lambda: ray_tpu.get(small_value.remote()))

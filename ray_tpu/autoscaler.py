@@ -184,6 +184,8 @@ class Autoscaler:
                     dict(spec.resources))
                 self._pending.append((tname, handle))
                 self._handles.append((tname, handle))
+                self._journal("autoscaler_scale_up", node_type=tname,
+                              resources=dict(spec.resources))
         # Busy nodes reset their idle clock regardless of which types
         # are draining this pass — a stale timestamp from an earlier
         # idle spell would otherwise terminate a node the instant its
@@ -200,6 +202,16 @@ class Autoscaler:
         quiet = [t for t in self.node_types if need.get(t, 0) == 0]
         if quiet:
             self._scale_down(state["nodes"], quiet)
+
+    def _journal(self, etype: str, **fields) -> None:
+        """Record a scaling decision in the head's cluster event journal
+        (reference: autoscaler events in `ray status`/the GCS event log).
+        Best-effort: journaling must never break reconciliation."""
+        try:
+            self.head.call("journal_record", {"type": etype, **fields},
+                           timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _live_counts(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
@@ -296,6 +308,9 @@ class Autoscaler:
                         now - first_idle >= self.idle_timeout_s:
                     logger.info("autoscaler: terminating idle %s node %s",
                                 tname, nid[:12])
+                    self._journal("autoscaler_scale_down", node_type=tname,
+                                  node_id=nid,
+                                  idle_s=round(now - first_idle, 1))
                     _, handle = self._launched.pop(nid)
                     self._idle_since.pop(nid, None)
                     # drain via the node's own shutdown RPC, addressed by

@@ -27,6 +27,7 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "object_pull_retry_ms": (int, 200, "pull retry interval"),
     "object_pull_chunk_inflight": (int, 8, "pipelined chunk requests per pull (reference: PushManager max_chunks_in_flight)"),
     "object_pull_max_concurrent": (int, 4, "concurrent large-object pulls per process (reference: PullManager admission control)"),
+    "object_accounting": (bool, True, "object-plane accounting: per-object directory + spill/pull counters riding telemetry_push ('python -m ray_tpu memory'); disable to A/B the bookkeeping overhead (bench_core object_accounting row)"),
     # --- rpc ---
     "rpc_connect_timeout_s": (float, 10.0, "client connect timeout"),
     "rpc_call_timeout_s": (float, 60.0, "default unary call deadline"),
@@ -85,6 +86,7 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "metrics_export_period_s": (float, 5.0, "metrics push period"),
     "hw_sampler_period_s": (float, 2.0, "node hardware sampler period (cpu/rss/cgroup/arena/tpu); 0 disables"),
     "timeseries_ring_points": (int, 512, "points kept per (node, metric) hardware time series at the head"),
+    "cluster_event_journal_size": (int, 4096, "structured cluster events (node/worker/actor/spill/lease/autoscaler transitions) kept in the head's journal ring ('python -m ray_tpu events'); oldest evict first"),
 }
 
 

@@ -163,6 +163,17 @@ class ReferenceCounter:
         with self._lock:
             return len(self._counts)
 
+    def counts_for(self, object_id: ObjectID) -> "Optional[dict]":
+        """Per-object pin counts for the accounting directory, or None if
+        this process doesn't track the object (e.g. a worker that sealed
+        a return value owned by the submitter)."""
+        with self._lock:
+            c = self._counts.get(object_id)
+            if c is None:
+                return None
+            return {"local": c.local, "submitted": c.submitted,
+                    "borrowers": len(c.borrowers), "owned": c.owned}
+
     def snapshot(self, limit: "Optional[int]" = None) -> dict:
         """Debug/telemetry view of the count table; ``limit`` bounds the
         under-lock work for large tables (telemetry samples)."""

@@ -22,6 +22,12 @@ Endpoints:
   GET /api/requests?live=1&slowest=N&request=RID
                    LLM request flight-recorder records (per-request
                    lifecycle timelines aggregated at the head)
+  GET /api/objects  per-object directory rows + exact per-node arena
+                   totals (`ray memory` parity; fed by owners'
+                   telemetry_push when object_accounting is on)
+  GET /api/events?after_seq=N&type=T&limit=K
+                   cluster event journal (node/worker/actor lifecycle,
+                   spill overflow, lease failures, autoscaler decisions)
   GET /api/timeline task spans (chrome-trace convertible)
   GET /api/jobs    submitted jobs
   GET /api/nodes   per-node agent stats (cpu/mem/disk/store/worker RSS —
@@ -131,6 +137,24 @@ class Dashboard:
                             "request": q.get("request", [""])[0],
                         }
                         data = client.call("requests_dump", payload,
+                                           timeout=10)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
+                    if parsed.path == "/api/objects":
+                        data = client.call("objects_dump", timeout=10)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
+                    if parsed.path == "/api/events":
+                        q = parse_qs(parsed.query)
+                        payload = {
+                            "after_seq": int(
+                                q.get("after_seq", ["0"])[0] or 0),
+                            "type": q.get("type", [""])[0],
+                            "limit": int(q.get("limit", ["0"])[0] or 0),
+                        }
+                        data = client.call("events_dump", payload,
                                            timeout=10)
                         self._send(200, json.dumps(
                             data, default=str).encode(), "application/json")

@@ -918,7 +918,13 @@ class ClusterBackend:
             sample = [{"object_id": oid, **counts}
                       for oid, counts in
                       self.worker.refcounter.snapshot(limit=50).items()]
-            objects = {"tracked": tracked, "sample": sample}
+            objects = {"tracked": tracked, "sample": sample,
+                       # reconciled per-object directory of everything this
+                       # process sealed into shm/spill ('ray_tpu memory')
+                       **self.object_plane.directory_export()}
+            # cluster events staged process-side (spill overflows) are
+            # sequenced by the head's journal when they land
+            journal = self.object_plane.drain_journal()
             # accelerator memory rides the worker flush: only worker
             # processes have jax live (the node daemon must never import
             # it), so HBM gauges originate here, tagged per worker since
@@ -935,14 +941,15 @@ class ClusterBackend:
             reqlog = sys.modules.get("ray_tpu.llm.request_log")
             llm_requests = reqlog.drain_all_exports() \
                 if reqlog is not None else []
-            if snap or events or tracked or samples or llm_requests:
+            if snap or events or tracked or samples or llm_requests \
+                    or journal:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
                     "node": self.local_node_id,
                     "metrics": snap, "events": events,
                     "objects": objects, "samples": samples,
-                    "llm_requests": llm_requests})
+                    "llm_requests": llm_requests, "journal": journal})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 

@@ -10,9 +10,10 @@ exports Chrome-trace JSON.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Deque, Dict, List
 
 
 class TaskEventBuffer:
@@ -20,7 +21,7 @@ class TaskEventBuffer:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._events: List[Dict[str, Any]] = []
+        self._events: Deque[Dict[str, Any]] = collections.deque()
         self._dropped = 0
 
     def record(self, *, name: str, task_id: str, kind: str,
@@ -28,11 +29,17 @@ class TaskEventBuffer:
         """Record one span. ``extra`` carries optional fields — notably
         the trace context trio (trace_id/span_id/parent_span_id) the OTLP
         exporter links spans by; falsy values are dropped so old-format
-        events keep their exact seed shape."""
+        events keep their exact seed shape.
+
+        The buffer is a ring: at MAX_BUFFER the OLDEST span is evicted so
+        a busy flush interval keeps its newest events (refusing the new
+        span instead would freeze the timeline at the interval's first
+        4096 spans); the ``__dropped__`` meta marker reports the exact
+        eviction count."""
         with self._lock:
             if len(self._events) >= self.MAX_BUFFER:
+                self._events.popleft()
                 self._dropped += 1
-                return
             e = {"name": name, "task_id": task_id, "kind": kind,
                  "start": start, "end": end, "ok": ok}
             for k, v in extra.items():
@@ -42,7 +49,7 @@ class TaskEventBuffer:
 
     def drain(self) -> List[Dict[str, Any]]:
         with self._lock:
-            out, self._events = self._events, []
+            out, self._events = list(self._events), collections.deque()
             if self._dropped:
                 out.append({"name": "__dropped__", "task_id": "",
                             "kind": "meta", "start": time.time(),

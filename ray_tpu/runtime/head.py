@@ -321,6 +321,13 @@ class Head:
         self._llm_requests: "collections.OrderedDict[str, dict]" = \
             collections.OrderedDict()
         self._llm_requests_cap = max(2, cfg.llm_request_log_size)
+        # structured cluster event journal (reference: GCS cluster-event
+        # log surfaced by `ray list cluster-events`): node/worker/actor
+        # transitions, spill overflows, lease failures, autoscaler moves —
+        # sequenced at arrival, dumped via events_dump
+        from ray_tpu.runtime.event_journal import ClusterEventJournal
+        self.journal = ClusterEventJournal(
+            capacity=cfg.cluster_event_journal_size)
         # unserviceable demand, deduped per (requester, shape): each
         # submitter polls its shape every ~0.2s, so per-poll appends would
         # over-count 25x per window (the autoscaler's demand signal;
@@ -361,6 +368,9 @@ class Head:
             "timeline_dump": self._h_timeline_dump,
             "timeseries_dump": self._h_timeseries_dump,
             "requests_dump": self._h_requests_dump,
+            "events_dump": self._h_events_dump,
+            "objects_dump": self._h_objects_dump,
+            "journal_record": self._h_journal_record,
             "autoscaler_state": self._h_autoscaler_state,
             "pubsub_publish": lambda p, c: self.pubsub.publish(
                 p["topic"], p["message"]),
@@ -674,6 +684,9 @@ class Head:
             self.pubsub.publish("cluster_events", {
                 "event": "node_added", "node_id": node_id,
                 "address": p["address"], "ts": time.time()})
+            self.journal.record("node_register", node_id=node_id,
+                                address=p["address"],
+                                resources=dict(p["resources"]))
         return {"session": self.session, "incarnation": self.incarnation,
                 "kill": kill}
 
@@ -1036,10 +1049,15 @@ class Head:
                                  "runtime_env": None})
         except RpcError:
             self._release(node_id, resources)
+            self.journal.record("lease_grant_failed", node_id=node_id,
+                                resources=dict(resources),
+                                reason="lease rpc failed (pool stock)")
             self._mark_node_dead(node_id, "lease rpc failed (pool stock)")
             return False
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
             self._release(node_id, resources)
+            self.journal.record("lease_grant_failed", node_id=node_id,
+                                resources=dict(resources), reason=repr(e))
             return False
         if not isinstance(grant, dict) or "worker_id" not in grant:
             self._release(node_id, resources)
@@ -1359,14 +1377,28 @@ class Head:
 
     def _h_worker_died(self, p, ctx):
         """Node daemon reports a worker process exit (reference: raylet
-        worker death -> GcsActorManager::OnWorkerDead)."""
+        worker death -> GcsActorManager::OnWorkerDead).
+
+        Journals the death with its exit cause under a trace id (ambient,
+        or freshly minted) that any follow-on actor-restart event shares,
+        so `events` shows the causal chain and `trace` can cross-link it.
+        """
+        from ray_tpu.util.trace_context import current, new_trace_id
+        ctx_t = current()
+        trace_id = ctx_t[0] if ctx_t else new_trace_id()
+        reason = p.get("reason", "worker died")
+        wid = p.get("worker_id") or b""
+        self.journal.record(
+            "worker_death", trace_id=trace_id,
+            worker_id=wid.hex() if isinstance(wid, bytes) else str(wid),
+            node_id=p.get("node_id", ""), exit_cause=reason)
         self._on_actor_worker_lost(
-            None, p.get("reason", "worker died"),
-            worker_id=p["worker_id"])
+            None, reason, worker_id=p["worker_id"], trace_id=trace_id)
         return True
 
     def _on_actor_worker_lost(self, actor_id: Optional[bytes], reason: str,
-                              worker_id: Optional[bytes] = None) -> None:
+                              worker_id: Optional[bytes] = None,
+                              trace_id: str = "") -> None:
         with self._lock:
             if actor_id is None and worker_id is not None:
                 actor_id = self._actor_by_worker.get(worker_id)
@@ -1392,6 +1424,10 @@ class Head:
             "event": "actor_restarting" if restart else "actor_dead",
             "actor_id": actor_id.hex(), "reason": reason,
             "ts": time.time()})
+        self.journal.record(
+            "actor_restarting" if restart else "actor_dead",
+            trace_id=trace_id, actor_id=actor_id.hex(), reason=reason,
+            restarts_left=entry.restarts_left)
         if restart:
             self._spawn_actor(entry)
 
@@ -1422,6 +1458,7 @@ class Head:
         self.pubsub.publish("cluster_events", {
             "event": "node_dead", "node_id": node_id, "reason": reason,
             "ts": time.time()})
+        self.journal.record("node_dead", node_id=node_id, reason=reason)
         for aid in dead_actor_ids:
             self._on_actor_worker_lost(aid, f"node {node_id} died: {reason}")
 
@@ -1617,7 +1654,58 @@ class Head:
             # a big batch never stalls lease/actor RPCs)
             self._timeseries.ingest(p.get("node") or p["worker"],
                                     p["samples"])
+        for ev in p.get("journal", ()):
+            # worker-originated cluster events (spill overflows): the
+            # journal assigns seq/ts at arrival so ordering is the head's
+            if isinstance(ev, dict) and ev.get("type"):
+                ev = dict(ev)
+                etype = ev.pop("type")
+                trace_id = ev.pop("trace_id", "")
+                ev.setdefault("worker", p["worker"][:12])
+                self.journal.record(etype, trace_id=trace_id, **ev)
         return True
+
+    def _h_events_dump(self, p, ctx):
+        """Cluster event journal dump (filters: after_seq cursor for
+        --follow, exact type, newest-N limit)."""
+        p = p or {}
+        return self.journal.dump(
+            after_seq=int(p.get("after_seq", 0) or 0),
+            type=p.get("type", ""),
+            limit=int(p.get("limit", 0) or 0))
+
+    def _h_journal_record(self, p, ctx):
+        """Out-of-band journal append for trusted controllers (the
+        autoscaler records its scaling decisions through this)."""
+        p = dict(p or {})
+        etype = p.pop("type", "") or "event"
+        trace_id = p.pop("trace_id", "")
+        return self.journal.record(etype, trace_id=trace_id, **p)["seq"]
+
+    def _h_objects_dump(self, p, ctx):
+        """Aggregated object directory: every reporter's reconciled rows
+        (stamped with node + reporter) plus per-node, per-role totals
+        summed over ALL entries — exact against ShmStore ground truth
+        even when per-reporter rows were truncated."""
+        cutoff = time.time() - self.METRICS_STALE_S
+        with self._lock:
+            for w in [w for w, e in self._objects.items()
+                      if e["ts"] < cutoff]:
+                del self._objects[w]
+            reporters = [(w, e) for w, e in self._objects.items()]
+        rows: List[dict] = []
+        totals: Dict[str, dict] = {}
+        for w, e in reporters:
+            for row in (e["snap"].get("dir") or ()):
+                rows.append({"node": e["node"], "reporter": w[:12], **row})
+            for role, t in (e["snap"].get("dir_totals") or {}).items():
+                node_tot = totals.setdefault(e["node"], {})
+                cur = node_tot.setdefault(
+                    role, {"count": 0, "bytes": 0, "arena_bytes": 0})
+                cur["count"] += t.get("count", 0)
+                cur["bytes"] += t.get("bytes", 0)
+                cur["arena_bytes"] += t.get("arena_bytes", 0)
+        return {"rows": rows, "totals": totals}
 
     def _h_metrics_dump(self, p, ctx):
         from ray_tpu.util.metrics import aggregate
@@ -1722,13 +1810,22 @@ class Head:
                 del self._objects[w]
             objects = [
                 {"owner": w[:12], "node": e["node"], "role": e["role"],
-                 **e["snap"]}
+                 **{k: v for k, v in e["snap"].items()
+                    if k not in ("dir", "dir_totals")}}
                 for w, e in self._objects.items()]
+            # flattened per-object directory rows (the `ray memory` /
+            # state.list_objects() surface; full totals via objects_dump)
+            objects_dir = [
+                {"node": e["node"], "reporter": w[:12], **row}
+                for w, e in self._objects.items()
+                for row in (e["snap"].get("dir") or ())]
             tasks = list(self._task_events)[-int(p.get("task_limit", 200)
                                                 if p else 200):]
             return {
                 "tasks": tasks,
                 "objects": objects,
+                "objects_dir": objects_dir,
+                "events": self.journal.stats(),
                 "nodes": [{"node_id": n.node_id, "address": n.address,
                            "alive": n.alive, "resources": n.resources}
                           for n in self._nodes.values()],

@@ -28,6 +28,7 @@ from ray_tpu.core import config as config_mod
 from ray_tpu.core._native import ShmStore
 from ray_tpu.core.ids import NodeID, WorkerID
 from ray_tpu.runtime.protocol import ClientPool, RpcError, RpcServer
+from ray_tpu.util import metrics as metrics_mod
 
 
 def _proc_dead(proc) -> bool:
@@ -98,6 +99,15 @@ class NodeDaemon:
             object_store_bytes or cfg.object_store_memory_bytes,
             cfg.object_store_max_objects)
         self._lock = threading.RLock()
+        # serve-side object-plane accounting: bytes shipped to remote
+        # pullers + spill restores served from disk; the hardware sampler
+        # loop pushes these to the head alongside its gauge samples
+        self._m_pull_out_bytes = \
+            metrics_mod.object_store_pull_out_bytes_counter()
+        self._m_spill_restore_total = \
+            metrics_mod.object_store_spill_restore_total_counter()
+        self._m_spill_restore_bytes = \
+            metrics_mod.object_store_spill_restore_bytes_counter()
         self._workers: Dict[bytes, _WorkerEntry] = {}
         # env_key -> FIFO of idle worker ids ('' = default environment)
         self._idle: Dict[str, List[bytes]] = {}
@@ -470,11 +480,15 @@ class NodeDaemon:
             try:
                 samples = sampler.sample()
                 if samples:
+                    # the metrics snapshot rides along so daemon-side
+                    # counters (pull-out bytes, spill restores served)
+                    # aggregate at the head like any worker's
                     self._clients.get(self.head_addr).oneway(
                         "telemetry_push", {
                             "worker": f"node:{self.node_id[:12]}",
                             "node": self.node_id, "role": "node",
-                            "samples": samples})
+                            "samples": samples,
+                            "metrics": metrics_mod.snapshot()})
             except Exception:  # noqa: BLE001 — head down: keep sampling
                 pass
 
@@ -754,11 +768,18 @@ class NodeDaemon:
         back to the node's spill directory for disk-overflowed objects."""
         view = self.store.get(p["object_id"])
         if view is None:
-            return self._read_spill(p["object_id"])
+            data = self._read_spill(p["object_id"])
+            if data is not None:
+                self._m_spill_restore_total.inc()
+                self._m_spill_restore_bytes.inc(len(data))
+                self._m_pull_out_bytes.inc(len(data))
+            return data
         try:
-            return bytes(view)
+            data = bytes(view)
         finally:
             self.store.release(p["object_id"])
+        self._m_pull_out_bytes.inc(len(data))
+        return data
 
     def _h_object_info(self, p, ctx):
         """Size probe for the chunked pull path (None = not here)."""
@@ -784,15 +805,19 @@ class NodeDaemon:
         view = self.store.get(p["object_id"])
         if view is not None:
             try:
-                return bytes(view[off:off + ln])
+                data = bytes(view[off:off + ln])
             finally:
                 self.store.release(p["object_id"])
+            self._m_pull_out_bytes.inc(len(data))
+            return data
         try:
             with open(self._spill_path(p["object_id"]), "rb") as f:
                 f.seek(off)
-                return f.read(ln)
+                data = f.read(ln)
         except OSError:
             return None
+        self._m_pull_out_bytes.inc(len(data))
+        return data
 
     def _spill_path(self, oid: bytes) -> str:
         from ray_tpu.core.config import GlobalConfig

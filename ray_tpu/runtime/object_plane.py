@@ -29,6 +29,8 @@ from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.exceptions import ObjectLostError
 from ray_tpu.runtime.protocol import ClientPool, RpcError
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import trace_context
 
 
 def spill_dir_for(session_dir: str, shm_name: str) -> str:
@@ -83,6 +85,34 @@ class ObjectPlane:
         # when a node dies with many pinned objects on it
         self._late_deletes: list = []   # (node_id, key)
         self._late_thread_live = False
+        # --- accounting: per-object directory + spill/pull counters that
+        # ride telemetry_push into the head ('python -m ray_tpu memory').
+        # Metric instances are cached here so the hot paths pay a plain
+        # attribute access, not a registry lookup per event.
+        self._acct = bool(config_mod.GlobalConfig.object_accounting)
+        self._dir: Dict[ObjectID, dict] = {}   # oid -> size/role/owner/created
+        self._journal_pending: list = []       # cluster events awaiting flush
+        if self._acct:
+            self._m_spill_write_total = \
+                metrics_mod.object_store_spill_write_total_counter()
+            self._m_spill_write_bytes = \
+                metrics_mod.object_store_spill_write_bytes_counter()
+            self._m_spill_restore_total = \
+                metrics_mod.object_store_spill_restore_total_counter()
+            self._m_spill_restore_bytes = \
+                metrics_mod.object_store_spill_restore_bytes_counter()
+            self._m_pull_in_bytes = \
+                metrics_mod.object_store_pull_in_bytes_counter()
+            self._m_pull_seconds = \
+                metrics_mod.object_store_pull_seconds_histogram()
+            self._m_fetch_inflight = \
+                metrics_mod.object_store_fetch_inflight_count_gauge()
+            self._m_primary_count = \
+                metrics_mod.object_store_primary_count_gauge()
+            self._m_secondary_count = \
+                metrics_mod.object_store_secondary_count_gauge()
+            self._m_spilled_count = \
+                metrics_mod.object_store_spilled_count_gauge()
 
     # ------------------------------------------------------------- directory
 
@@ -144,9 +174,11 @@ class ObjectPlane:
             # (reference: LocalObjectManager::SpillObjects — spilled copies
             # restore on demand; see spill_path/_h_read_object fallbacks)
             self._write_spill(object_id, so.to_bytes())
+            self._dir_record(object_id, so.total_bytes, "spilled")
             return
         so.write_to(memoryview(buf).cast("B"))
         self.store.seal(object_id.binary())
+        self._dir_record(object_id, so.total_bytes, "primary")
 
     # ---------------------------------------------------------------- spill
 
@@ -162,19 +194,41 @@ class ObjectPlane:
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)
+        if self._acct:
+            self._m_spill_write_total.inc()
+            self._m_spill_write_bytes.inc(len(data))
+            # arena overflow is a cluster-visible condition: queue a
+            # journal event for the next telemetry flush, carrying the
+            # ambient trace (if any) so `trace` can cross-link it
+            ctx = trace_context.current()
+            with self._lock:
+                if len(self._journal_pending) < 256:
+                    self._journal_pending.append({
+                        "type": "spill_overflow",
+                        "object_id": object_id.hex(),
+                        "bytes": len(data),
+                        "node": self.local_node_id,
+                        "trace_id": ctx[0] if ctx else ""})
 
     def _read_spill(self, object_id: ObjectID) -> Optional[bytes]:
         from ray_tpu.core.config import GlobalConfig
-        return read_spill_file(GlobalConfig.session_dir, self.store.name,
+        data = read_spill_file(GlobalConfig.session_dir, self.store.name,
                                object_id.hex())
+        if data is not None and self._acct:
+            self._m_spill_restore_total.inc()
+            self._m_spill_restore_bytes.inc(len(data))
+        return data
 
     def store_result_bytes(self, object_id: ObjectID, data: bytes,
-                           pin: bool = True) -> str:
+                           pin: bool = True, owner: str = "") -> str:
         """Seal pre-serialized bytes into local shm.
 
         ``pin=True`` keeps the creator pin (primary copy — freed by the
         owner's delete path); ``pin=False`` releases it so the copy is an
-        LRU-evictable cache (secondary copies from pulls). Returns this
+        LRU-evictable cache (secondary copies from pulls). ``owner`` is
+        the owning worker's hex id for the accounting directory (defaults
+        to this process — correct for driver puts, overridden when a
+        worker seals a return value owned by the submitter). Returns this
         node's id (reported to the owner as the location).
         """
         try:
@@ -183,6 +237,8 @@ class ObjectPlane:
             self.store.seal(object_id.binary())
             if not pin:
                 self.store.release(object_id.binary())
+            self._dir_record(object_id, len(data),
+                             "primary" if pin else "secondary", owner)
         except ObjectExists:
             pass
         except ObjectStoreFull:
@@ -190,11 +246,101 @@ class ObjectPlane:
                 # primary copy: overflow to disk; the owner's free path
                 # (delete_object -> node handler) unlinks it
                 self._write_spill(object_id, data)
+                self._dir_record(object_id, len(data), "spilled", owner)
             # secondary (cache) copies are NOT spilled: nothing would ever
             # delete them (owner free only reaches the primary node), so
             # they'd leak until node shutdown — callers fall back to the
             # in-memory bytes for the current read instead
         return self.local_node_id
+
+    # ------------------------------------------------------------ accounting
+
+    #: shm_store.cc kAlign — the arena charges align_up(size, 64) per
+    #: object, so directory totals report the arena footprint separately
+    #: from raw serialized bytes (`bytes_used` ground truth == arena_bytes)
+    _ARENA_ALIGN = 64
+
+    def _dir_record(self, object_id: ObjectID, size: int, role: str,
+                    owner: str = "") -> None:
+        if not self._acct:
+            return
+        with self._lock:
+            self._dir[object_id] = {
+                "size": int(size), "role": role,
+                "owner": owner or self.worker.worker_id.hex(),
+                "created": time.time()}
+
+    def directory_export(self, limit: int = 200) -> dict:
+        """Reconciled directory for the telemetry flush: per-object rows
+        (largest first, capped at ``limit``) plus EXACT per-role totals
+        over all live entries, so head-side byte/count totals stay exact
+        even when rows are truncated.
+
+        Reconciliation happens at report time, not event time: a row
+        whose shm copy was LRU-evicted (secondaries) or freed behind our
+        back is dropped, and a primary that only survives as a spill file
+        is demoted to role=spilled — the head table never shows ghosts.
+        """
+        if not self._acct:
+            return {}
+        from ray_tpu.core.config import GlobalConfig
+        now = time.time()
+        with self._lock:
+            items = list(self._dir.items())
+        rows: list = []
+        dead: list = []
+        demoted: list = []
+        totals: Dict[str, dict] = {}
+        align = self._ARENA_ALIGN - 1
+        for oid, e in items:
+            role, size = e["role"], e["size"]
+            spill = spill_file_path(GlobalConfig.session_dir,
+                                    self.store.name, oid.hex())
+            if role in ("primary", "secondary") \
+                    and not self.store.contains(oid.binary()):
+                if role == "primary" and os.path.exists(spill):
+                    role = "spilled"
+                    demoted.append(oid)
+                else:
+                    dead.append(oid)
+                    continue
+            elif role == "spilled" and not os.path.exists(spill):
+                dead.append(oid)
+                continue
+            t = totals.setdefault(role,
+                                  {"count": 0, "bytes": 0, "arena_bytes": 0})
+            t["count"] += 1
+            t["bytes"] += size
+            if role != "spilled":
+                t["arena_bytes"] += (size + align) & ~align
+            rows.append({
+                "object_id": oid.hex(), "size": size, "role": role,
+                "owner": e["owner"][:12],
+                "age_s": round(now - e["created"], 3),
+                "pins": self.worker.refcounter.counts_for(oid)})
+        if dead or demoted:
+            with self._lock:
+                for oid in dead:
+                    self._dir.pop(oid, None)
+                for oid in demoted:
+                    if oid in self._dir:
+                        self._dir[oid]["role"] = "spilled"
+        self._m_primary_count.set(
+            totals.get("primary", {}).get("count", 0))
+        self._m_secondary_count.set(
+            totals.get("secondary", {}).get("count", 0))
+        self._m_spilled_count.set(
+            totals.get("spilled", {}).get("count", 0))
+        rows.sort(key=lambda r: -r["size"])
+        if limit and len(rows) > limit:
+            rows = rows[:limit]
+        return {"dir": rows, "dir_totals": totals}
+
+    def drain_journal(self) -> list:
+        """Pending cluster events (spill overflows) for telemetry_push."""
+        with self._lock:
+            out, self._journal_pending = self._journal_pending, []
+        return out
 
     def _register_contained(self, object_id: ObjectID, refs: list) -> None:
         """An owned object embeds other refs: hold borrows until it's freed
@@ -239,6 +385,9 @@ class ObjectPlane:
             if ref.id() in self._fetching:
                 return
             self._fetching.add(ref.id())
+            inflight = len(self._fetching)
+        if self._acct:
+            self._m_fetch_inflight.set(inflight)
         threading.Thread(target=self._fetch_loop, args=(ref,), daemon=True,
                          name="objplane-fetch").start()
 
@@ -284,7 +433,8 @@ class ObjectPlane:
                     try:
                         oneshot = self._pull_to_local(
                             ref.id(), reply["shm"],
-                            sources=reply.get("shm_all"))
+                            sources=reply.get("shm_all"),
+                            owner=ref.owner_id().hex())
                     except (RpcError, ObjectLostError) as e:
                         # holder node died mid-pull: surface the loss
                         # instead of killing this thread (a silent death
@@ -305,9 +455,13 @@ class ObjectPlane:
         finally:
             with self._lock:
                 self._fetching.discard(ref.id())
+                inflight = len(self._fetching)
+            if self._acct:
+                self._m_fetch_inflight.set(inflight)
 
     def _pull_to_local(self, object_id: ObjectID, node_id: str,
-                       sources: Optional[list] = None) -> Optional[bytes]:
+                       sources: Optional[list] = None,
+                       owner: str = "") -> Optional[bytes]:
         """Fetch a sealed object from remote node(s) into the local arena
         (reference pull path: pull_manager.h:53 -> ObjectManager::Push).
 
@@ -325,6 +479,7 @@ class ObjectPlane:
         key = object_id.binary()
         if node_id == self.local_node_id or self.store.contains(key):
             return None
+        t0 = time.perf_counter()
         srcs = [node_id] + [s for s in (sources or ())
                             if s != node_id and s != self.local_node_id]
         cfg = config_mod.GlobalConfig
@@ -348,15 +503,22 @@ class ObjectPlane:
             if data is None:
                 raise ObjectLostError(object_id.hex(),
                                       f"gone from {srcs[0]}")
-            self.store_result_bytes(object_id, data, pin=False)
+            if self._acct:
+                self._m_pull_in_bytes.inc(len(data))
+                self._m_pull_seconds.observe(time.perf_counter() - t0)
+            self.store_result_bytes(object_id, data, pin=False, owner=owner)
             if not self.store.contains(key):
                 return data  # cache miss (arena full): one-shot bytes
             return None
         with self._pull_sem:
-            return self._pull_chunked(object_id, info["size"], chunk, srcs)
+            out = self._pull_chunked(object_id, info["size"], chunk, srcs,
+                                     owner)
+        if self._acct:
+            self._m_pull_seconds.observe(time.perf_counter() - t0)
+        return out
 
     def _pull_chunked(self, object_id: ObjectID, size: int, chunk: int,
-                      sources: list) -> Optional[bytes]:
+                      sources: list, owner: str = "") -> Optional[bytes]:
         cfg = config_mod.GlobalConfig
         key = object_id.binary()
         cached = False
@@ -387,6 +549,7 @@ class ObjectPlane:
         if cached:
             self.store.seal(key)
             self.store.release(key)  # secondary copy: LRU-evictable
+            self._dir_record(object_id, size, "secondary", owner)
             return None
         return bytes(dest)
 
@@ -452,6 +615,8 @@ class ObjectPlane:
                     issue(off, attempts + 1)
                     continue
                 dest[off:off + ln] = data
+                if self._acct:
+                    self._m_pull_in_bytes.inc(ln)
                 if next_i < len(offsets):
                     issue(offsets[next_i])
                     next_i += 1
@@ -492,7 +657,8 @@ class ObjectPlane:
             else:
                 with self._lock:
                     sources = list(self.secondary.get(oid, ()))
-            oneshot = self._pull_to_local(oid, node_id, sources=sources)
+            oneshot = self._pull_to_local(oid, node_id, sources=sources,
+                                          owner=ref.owner_id().hex())
             if oneshot is not None:
                 return serialization.deserialize(oneshot), False
             self._notify_pulled(ref)
@@ -573,6 +739,7 @@ class ObjectPlane:
         node_id = self.locations.pop(object_id, None)
         with self._lock:
             secondaries = self.secondary.pop(object_id, set())
+            self._dir.pop(object_id, None)
         secondaries.discard(node_id)
         # Oneway, and never a blocking call on THIS thread: this path runs
         # inside reply callbacks on the transport dispatcher, and

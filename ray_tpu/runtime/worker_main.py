@@ -591,7 +591,8 @@ class Executor:
             else:
                 oid = ObjectID.for_return(tid, i + 1)
                 node = self.backend.object_plane.store_result_bytes(
-                    oid, so.to_bytes())
+                    oid, so.to_bytes(),
+                    owner=(payload.get("owner") or b"").hex())
                 results.append({"in_shm": node})
         # Transfer-before-release (owner-side): refs WE own riding in this
         # reply get the caller pre-registered as a borrower BEFORE the
@@ -626,7 +627,8 @@ class Executor:
             # creator pin released: the owner's ref is the only keeper, and
             # streamed items are meant to be consumed-and-dropped
             msg["in_shm"] = self.backend.object_plane.store_result_bytes(
-                oid, so.to_bytes())
+                oid, so.to_bytes(),
+                owner=(payload.get("owner") or b"").hex())
         caller = payload.get("owner")
         for r in so.contained_refs:
             # same transfer-before-release as _reply_ok

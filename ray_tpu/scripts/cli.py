@@ -13,6 +13,8 @@ Commands:
   status [--address H:P]                     cluster resources + nodes
   list {nodes,actors,workers,placement-groups,objects} [--address H:P]
   top [--watch] [--interval S]               node/worker hardware table
+  memory [--group-by node|owner] [--top N]   object-store directory + totals
+  events [--follow] [--type T]               cluster event journal
   requests [--slowest N] [--live]            LLM request timelines
   trace [--request RID | --trace-id T]       span tree / request timeline
   stop [--address H:P]                       stop node daemons + head
@@ -279,6 +281,29 @@ def _render_top(client, address: str) -> str:
         if slo_ttft is not None and slo_tpot is not None:
             llm_line += (f"  slo ttft {slo_ttft:.0%} "
                          f"tpot {slo_tpot:.0%}")
+
+    # object-store summary: used/cap from the hardware series, spill and
+    # pull rates from the accounting counters (object_accounting=True)
+    store_line = ""
+    st_used = sum(v.get("object_store_used_bytes", 0)
+                  for v in node_gauges.values())
+    st_cap = sum(v.get("object_store_capacity_bytes", 0)
+                 for v in node_gauges.values())
+    spill_n = _gauge("object_store_spill_write_total")
+    spill_b = _gauge("object_store_spill_write_bytes")
+    pull_in = _gauge("object_store_pull_in_bytes")
+    pull_out = _gauge("object_store_pull_out_bytes")
+    infl = _gauge("object_store_fetch_inflight_count")
+    if st_cap or spill_n is not None or pull_in is not None:
+        store_line = (f"store: {_fmt_bytes(st_used)}/{_fmt_bytes(st_cap)}"
+                      f"  spills {spill_n or 0:g}"
+                      f" ({_fmt_bytes(spill_b or 0)})"
+                      f"  pull in/out {_fmt_bytes(pull_in or 0)}/"
+                      f"{_fmt_bytes(pull_out or 0)}"
+                      f"  fetches {infl or 0:g}")
+        p50 = _hist_quantile(metrics, "object_store_pull_seconds", 0.5)
+        if p50 is not None:
+            store_line += f"  pull p50<={p50 * 1e3:.0f}ms"
     nodes = dump["nodes"]
     alive = [n for n in nodes if n["alive"]]
     lines = [
@@ -287,7 +312,8 @@ def _render_top(client, address: str) -> str:
         f"queue_depth {queue_depth:g}"
         + (f"  serve_inflight {sum(inflight.values()):g}" if inflight
            else ""),
-    ] + ([llm_line] if llm_line else []) + [
+    ] + ([llm_line] if llm_line else []) \
+      + ([store_line] if store_line else []) + [
         "",
         f"{'NODE':<14}{'ALIVE':<7}{'CPU%':>6}  {'MEM':>19}  "
         f"{'STORE':>19}  {'OBJS':>6}  {'HBM':>19}",
@@ -350,6 +376,123 @@ def cmd_top(args) -> int:
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_memory(args) -> int:
+    """Cluster object-store directory: every tracked object with size,
+    role (primary/secondary/spilled), owner, age and pin counts, grouped
+    per node or per owner, plus exact per-node arena totals (reference:
+    `ray memory`, python/ray/util/state/memory_utils.py — theirs walks
+    core-worker ref tables; ours rides the owners' telemetry_push)."""
+    address = load_address(args.address)
+    client = _client(address)
+    od = client.call("objects_dump", timeout=10) or {}
+    rows = list(od.get("rows", ()))
+    totals = od.get("totals", {})
+    if args.format == "json":
+        print(json.dumps({"rows": rows, "totals": totals},
+                         indent=2, default=str))
+        return 0
+    # leak heuristic: a PRIMARY that has sat in the arena past --leak-age
+    # with no live references at its owner (or whose owner process no
+    # longer reports at all) is probably a leaked ObjectRef. Heuristic
+    # only: drivers legitimately hold old pinned results.
+    reporters = {r.get("reporter", "") for r in rows}
+    leaks = 0
+    for r in rows:
+        pins = r.get("pins")
+        unreferenced = (pins is not None
+                        and not (pins.get("local") or pins.get("submitted")
+                                 or pins.get("borrowers")))
+        orphaned = pins is None and r.get("owner", "") not in reporters
+        r["_leak"] = bool(r.get("role") == "primary"
+                          and r.get("age_s", 0) > args.leak_age
+                          and (unreferenced or orphaned))
+        leaks += r["_leak"]
+    key = "node" if args.group_by == "node" else "owner"
+    groups = {}
+    for r in rows:
+        groups.setdefault(str(r.get(key, "?")), []).append(r)
+    n_bytes = sum(r.get("size", 0) for r in rows)
+    print(f"object store @ {address}: {len(rows)} object(s), "
+          f"{_fmt_bytes(n_bytes)} tracked"
+          + (f", {leaks} LEAK suspect(s)" if leaks else ""))
+    for gid in sorted(groups):
+        rs = sorted(groups[gid], key=lambda r: -r.get("size", 0))
+        gb = sum(r.get("size", 0) for r in rs)
+        print(f"\n{key} {gid[:12]}  "
+              f"({len(rs)} object(s), {_fmt_bytes(gb)})")
+        if key == "node":
+            for role, t in sorted((totals.get(gid) or {}).items()):
+                print(f"  {role:<10} count={t['count']} "
+                      f"bytes={t['bytes']} arena_bytes={t['arena_bytes']}")
+        for r in rs[:args.top]:
+            pins = r.get("pins")
+            pin_s = (f"l{pins['local']}/s{pins['submitted']}"
+                     f"/b{pins['borrowers']}" if pins else "-")
+            print(f"  {str(r.get('object_id', '?'))[:16]:<18}"
+                  f"{_fmt_bytes(r.get('size', 0)):>10}  "
+                  f"{r.get('role', '?'):<10}"
+                  f"owner={str(r.get('owner', '?')):<14}"
+                  f"age={r.get('age_s', 0):>7.1f}s  pins={pin_s}"
+                  + ("  LEAK?" if r.get("_leak") else ""))
+        if len(rs) > args.top:
+            print(f"  ... {len(rs) - args.top} more")
+    if not rows:
+        print("(no object directory rows at the head yet — owners flush "
+              "every metrics_export_period_s; object_accounting on?)")
+    return 0
+
+
+def _fmt_event(ev: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+    ms = int((ev.get("ts", 0) % 1) * 1000)
+    extras = "  ".join(
+        f"{k}={v}" for k, v in sorted(ev.items())
+        if k not in ("seq", "ts", "type", "trace_id"))
+    trace = f"  trace={ev['trace_id']}" if ev.get("trace_id") else ""
+    return (f"#{ev.get('seq', 0):<6} {ts}.{ms:03d}  "
+            f"{ev.get('type', '?'):<22} {extras}{trace}")
+
+
+def cmd_events(args) -> int:
+    """Head's cluster event journal: node register/dead, worker death
+    (exit cause), actor restart/dead, spill overflow, lease-grant
+    failures, autoscaler decisions — monotonically sequenced and
+    trace-id stamped (reference: `ray list cluster_events` over the GCS
+    event journal; src/ray/gcs keeps the same bounded ring)."""
+    address = load_address(args.address)
+    client = _client(address)
+    if not args.follow:
+        evs = client.call("events_dump",
+                          {"type": args.type or "",
+                           "limit": int(args.limit or 0)}, timeout=10)
+        if args.format == "json":
+            print(json.dumps(evs, indent=2, default=str))
+            return 0
+        for ev in evs:
+            print(_fmt_event(ev))
+        print(f"({len(evs)} event(s))", file=sys.stderr)
+        return 0
+    after = 0
+    frames = args.frames  # hidden test hook: bounded poll count
+    try:
+        while True:
+            evs = client.call("events_dump",
+                              {"after_seq": after,
+                               "type": args.type or ""}, timeout=10)
+            for ev in evs:
+                print(_fmt_event(ev))
+                after = max(after, int(ev.get("seq", 0)))
+            sys.stdout.flush()
+            if frames is not None:
+                frames -= 1
+                if frames <= 0:
+                    break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def _fmt_ms(v) -> str:
@@ -618,6 +761,37 @@ def main(argv=None) -> int:
                     help="repaint continuously until ctrl-c")
     sp.add_argument("--interval", type=float, default=2.0)
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("memory",
+                        help="object-store directory: per-object rows "
+                             "(size, role, owner, pins) + per-node arena "
+                             "totals and a leak heuristic")
+    sp.add_argument("--address")
+    sp.add_argument("--group-by", choices=["node", "owner"],
+                    default="node", dest="group_by")
+    sp.add_argument("--top", type=int, default=10,
+                    help="largest N objects per group")
+    sp.add_argument("--leak-age", type=float, default=300.0,
+                    help="flag unreferenced primaries older than this (s)")
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("events",
+                        help="cluster event journal (node/worker/actor "
+                             "lifecycle, spill overflow, lease failures, "
+                             "autoscaler decisions)")
+    sp.add_argument("--address")
+    sp.add_argument("--type", default="",
+                    help="only events of this type (e.g. worker_death)")
+    sp.add_argument("--limit", type=int, default=0,
+                    help="newest N events only")
+    sp.add_argument("--follow", action="store_true",
+                    help="poll for new events until ctrl-c")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--frames", type=int, default=None,
+                    help=argparse.SUPPRESS)  # test hook: bounded polls
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("timeline", help="export task timeline "
                                          "(chrome trace)")

@@ -385,6 +385,84 @@ def tune_running_trials_gauge() -> Gauge:
                  description="trials currently running")
 
 
+# -- object-plane accounting (reference: object store / object manager
+# stats feeding `ray memory` and the object-store dashboard panels).
+# Every series here follows <subsystem>_<noun>_<unit> with the unit in
+# {bytes, seconds, total, count} — tests/test_state_cli.py lints the set.
+
+def object_store_spill_write_total_counter() -> Counter:
+    """Objects spilled to disk because the shm arena was full at seal
+    (primaries are pinned, so eviction can't make room for them)."""
+    return Counter("object_store_spill_write_total",
+                   description="objects spilled to disk (arena full at "
+                               "seal)")
+
+
+def object_store_spill_write_bytes_counter() -> Counter:
+    return Counter("object_store_spill_write_bytes",
+                   description="serialized bytes written to spill files")
+
+
+def object_store_spill_restore_total_counter() -> Counter:
+    """Spilled objects read back (local get fallback or served to a
+    remote puller)."""
+    return Counter("object_store_spill_restore_total",
+                   description="spill files read back to satisfy a get "
+                               "or a remote pull")
+
+
+def object_store_spill_restore_bytes_counter() -> Counter:
+    return Counter("object_store_spill_restore_bytes",
+                   description="bytes read back from spill files")
+
+
+def object_store_pull_in_bytes_counter() -> Counter:
+    """Object bytes fetched INTO this process from remote holders
+    (whole-object reads + chunked pulls)."""
+    return Counter("object_store_pull_in_bytes",
+                   description="object bytes pulled in from remote nodes")
+
+
+def object_store_pull_out_bytes_counter() -> Counter:
+    """Object bytes this node daemon served OUT to remote pullers."""
+    return Counter("object_store_pull_out_bytes",
+                   description="object bytes served to remote pullers")
+
+
+def object_store_pull_seconds_histogram() -> Histogram:
+    """Whole-object pull latency (resolve reply to local availability),
+    one observation per pulled object regardless of chunk count."""
+    return Histogram("object_store_pull_seconds",
+                     description="seconds to pull one object to the "
+                                 "local node")
+
+
+def object_store_fetch_inflight_count_gauge() -> Gauge:
+    """Owner-resolve fetch loops currently running in this process."""
+    return Gauge("object_store_fetch_inflight_count",
+                 description="active object fetch loops")
+
+
+def object_store_primary_count_gauge() -> Gauge:
+    """Primary (pinned) copies this process sealed and still accounts."""
+    return Gauge("object_store_primary_count",
+                 description="live primary copies in this process's "
+                             "directory")
+
+
+def object_store_secondary_count_gauge() -> Gauge:
+    """Secondary (pull-cache, LRU-evictable) copies still resident."""
+    return Gauge("object_store_secondary_count",
+                 description="live secondary (cache) copies in this "
+                             "process's directory")
+
+
+def object_store_spilled_count_gauge() -> Gauge:
+    """Objects currently living only in spill files."""
+    return Gauge("object_store_spilled_count",
+                 description="objects currently resident only on disk")
+
+
 def aggregate(per_worker: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:
     """Merge worker snapshots: counters/histograms sum, gauges last-write.
     (head-side; reference: metrics agent → Prometheus aggregation)."""

@@ -30,6 +30,11 @@ def _dump(task_limit: int = 200) -> dict:
         "objects": [{"owner": "local", "node": "local", "role": "driver",
                      "tracked": worker.refcounter.num_tracked(),
                      "sample": []}],
+        # shape parity with the cluster head's state_dump: local mode has
+        # no shm arena (everything lives in the in-process store) and no
+        # journal, so both accounting surfaces are legitimately empty
+        "objects_dir": [],
+        "events": {"recorded": 0, "kept": 0},
     }
 
 
@@ -56,15 +61,19 @@ def list_tasks(limit: int = 200) -> List[Dict]:
 
 
 def list_objects() -> List[Dict]:
-    """Per-owner object-table summaries (tracked count + a sample of
-    entries with local/submitted/borrower counts) — the reference's
-    `ray list objects` role under the ownership model: owners are the
-    authority, so the head aggregates their telemetry reports."""
-    return _dump().get("objects", [])
+    """Per-object directory rows (object_id, size, role primary/
+    secondary/spilled, owner, age, pin counts, node) — the reference's
+    `ray list objects` under the ownership model: owners are the
+    authority, so the head aggregates the directory each owner ships in
+    its telemetry report. Falls back to the coarser per-owner summaries
+    when the accounting directory is empty (object_accounting off)."""
+    d = _dump()
+    return d.get("objects_dir") or d.get("objects", [])
 
 
 def summarize() -> Dict:
     d = _dump()
+    events = d.get("events") or {}
     return {
         "nodes_alive": sum(1 for n in d["nodes"] if n["alive"]),
         "nodes_total": len(d["nodes"]),
@@ -72,4 +81,10 @@ def summarize() -> Dict:
         "actors_alive": sum(1 for a in d["actors"] if a["state"] == "ALIVE"),
         "placement_groups": len(d["placement_groups"]),
         "active_leases": d["leases"],
+        "tasks": len(d.get("tasks", [])),
+        "objects": sum(int(o.get("tracked", 0))
+                       for o in d.get("objects", [])),
+        "objects_in_directory": len(d.get("objects_dir", [])),
+        "events_recorded": int(events.get("recorded", 0)),
+        "events_kept": int(events.get("kept", 0)),
     }

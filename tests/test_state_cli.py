@@ -223,6 +223,212 @@ def test_requests_cli_live_watch(state_rt):
     assert "req-clifast-0" in out
 
 
+def _seed_object_directory(probe):
+    """Push one fabricated owner directory + a worker-originated journal
+    event into the head, exactly the wire shape cluster_backend's
+    _flush_telemetry emits (dir rows + dir_totals + journal list)."""
+    probe.call("telemetry_push", {
+        "worker": "memworker" + "0" * 23, "node": "memnode" + "0" * 25,
+        "role": "worker",
+        "objects": {
+            "tracked": 2, "sample": [],
+            "dir": [
+                {"object_id": "aa" * 14, "size": 1048576,
+                 "role": "primary", "owner": "memworker000",
+                 "age_s": 999.0,
+                 "pins": {"local": 0, "submitted": 0, "borrowers": 0,
+                          "owned": True}},
+                {"object_id": "bb" * 14, "size": 4096,
+                 "role": "secondary", "owner": "elsewhere000",
+                 "age_s": 1.0, "pins": None},
+            ],
+            "dir_totals": {
+                "primary": {"count": 1, "bytes": 1048576,
+                            "arena_bytes": 1048576},
+                "secondary": {"count": 1, "bytes": 4096,
+                              "arena_bytes": 4096}},
+        },
+        "journal": [{"type": "spill_overflow", "object_id": "cc" * 14,
+                     "bytes": 2048, "node": "memnode" + "0" * 25}],
+    }, timeout=10)
+
+
+def test_memory_cli(state_rt):
+    """`memory` renders the head's aggregated object directory grouped
+    by node with per-role totals and flags old unreferenced primaries;
+    --format json round-trips the exact rows/totals."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    address = global_worker.backend.head_addr
+    _seed_object_directory(global_worker.backend.head)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["memory", "--address", address]) == 0
+    out = buf.getvalue()
+    assert "memnode00000" in out          # node group header
+    assert "primary" in out and "secondary" in out
+    # the 999s-old zero-pin primary trips the leak heuristic; the fresh
+    # secondary does not
+    assert "LEAK?" in out and "1 LEAK suspect(s)" in out
+    assert "pins=l0/s0/b0" in out
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["memory", "--format", "json",
+                         "--address", address]) == 0
+    data = json.loads(buf.getvalue())
+    t = data["totals"]["memnode" + "0" * 25]
+    assert t["primary"] == {"count": 1, "bytes": 1048576,
+                            "arena_bytes": 1048576}
+    assert t["secondary"]["arena_bytes"] == 4096
+    rows = [r for r in data["rows"] if r.get("reporter") == "memworker000"]
+    assert {r["role"] for r in rows} == {"primary", "secondary"}
+
+    # grouped by owner: the two rows land in different groups
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["memory", "--group-by", "owner",
+                         "--address", address]) == 0
+    out = buf.getvalue()
+    assert "owner memworker000" in out and "owner elsewhere000" in out
+
+
+def test_events_cli(state_rt):
+    """`events` dumps the head journal in sequence order; --type
+    filters; --follow with the hidden --frames hook terminates; json
+    output carries strictly increasing seqs."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    address = global_worker.backend.head_addr
+    _seed_object_directory(global_worker.backend.head)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["events", "--address", address]) == 0
+    out = buf.getvalue()
+    # the fixture cluster registered its node; the seed pushed a
+    # worker-originated spill event sequenced at head arrival
+    assert "node_register" in out and "spill_overflow" in out
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["events", "--type", "spill_overflow",
+                         "--address", address]) == 0
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    assert lines and all("spill_overflow" in ln for ln in lines)
+    assert not any("node_register" in ln for ln in lines)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["events", "--format", "json",
+                         "--address", address]) == 0
+    evs = json.loads(buf.getvalue())
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert all(e.get("ts") for e in evs)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["events", "--follow", "--interval", "0.05",
+                         "--frames", "2", "--address", address]) == 0
+    assert "spill_overflow" in buf.getvalue()
+
+
+def test_object_store_metric_names_follow_convention():
+    """Every object-store series name is <subsystem>_<noun>_<unit> with
+    the unit one of bytes|seconds|total|count (Prometheus naming; lint
+    so new series stay greppable + renderable without special cases)."""
+    import re
+
+    from ray_tpu.util import metrics as m
+
+    factories = [
+        m.object_store_spill_write_total_counter,
+        m.object_store_spill_write_bytes_counter,
+        m.object_store_spill_restore_total_counter,
+        m.object_store_spill_restore_bytes_counter,
+        m.object_store_pull_in_bytes_counter,
+        m.object_store_pull_out_bytes_counter,
+        m.object_store_pull_seconds_histogram,
+        m.object_store_fetch_inflight_count_gauge,
+        m.object_store_primary_count_gauge,
+        m.object_store_secondary_count_gauge,
+        m.object_store_spilled_count_gauge,
+    ]
+    pat = re.compile(
+        r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)*_(bytes|seconds|total|count)$")
+    names = set()
+    for f in factories:
+        inst = f()
+        assert pat.match(inst.name), inst.name
+        assert inst.name.startswith("object_store_"), inst.name
+        names.add(inst.name)
+    assert len(names) == len(factories)  # no duplicate registrations
+
+
+def test_task_event_buffer_ring_eviction():
+    """Satellite: the span buffer is a ring — at MAX_BUFFER the OLDEST
+    spans are evicted (not the newest refused) and the __dropped__
+    marker reports the exact eviction count."""
+    from ray_tpu.runtime.events import TaskEventBuffer
+
+    buf = TaskEventBuffer()
+    n = TaskEventBuffer.MAX_BUFFER + 10
+    for i in range(n):
+        buf.record(name=f"t{i}", task_id=f"id{i}", kind="task",
+                   start=float(i), end=float(i) + 0.5, ok=True)
+    out = buf.drain()
+    marker = [e for e in out if e["name"] == "__dropped__"]
+    assert len(marker) == 1 and marker[0]["dropped"] == 10
+    spans = [e for e in out if e["name"] != "__dropped__"]
+    assert len(spans) == TaskEventBuffer.MAX_BUFFER
+    # oldest went first: the survivors are exactly t10..t(n-1), in order
+    assert spans[0]["name"] == "t10" and spans[-1]["name"] == f"t{n - 1}"
+    # ring drained + marker reset: the next drain is clean
+    assert buf.drain() == []
+
+
+def test_local_mode_dump_synthesis():
+    """Satellite: local mode has no head, so util/state._dump synthesizes
+    the state_dump shape in-process — including the empty accounting
+    surfaces (objects_dir, events) the cluster path always carries.
+    Subprocess because the module fixture holds a cluster connection."""
+    code = """
+import ray_tpu as rt
+rt.init(local_mode=True)
+from ray_tpu.util import state
+d = state._dump()
+assert d["nodes"][0]["node_id"] == "local" and d["nodes"][0]["alive"]
+assert d["objects_dir"] == []
+assert d["events"] == {"recorded": 0, "kept": 0}
+assert d["objects"][0]["owner"] == "local"
+objs = state.list_objects()
+assert objs and objs[0]["owner"] == "local"   # summary fallback path
+s = state.summarize()
+assert s["tasks"] == 0 and s["events_recorded"] == 0
+assert s["objects_in_directory"] == 0
+assert s["nodes_alive"] == 1
+print("OK-LOCAL")
+"""
+    import os
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": os.path.dirname(
+            os.path.dirname(rt.__file__))})
+    assert out.returncode == 0, out.stderr
+    assert "OK-LOCAL" in out.stdout
+
+
 def test_cli_status_and_list(state_rt):
     from ray_tpu.core.worker import global_worker
     address = global_worker.backend.head_addr
