@@ -79,6 +79,16 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "llm_request_log_size": (int, 256, "request records kept in the engine-side ring (and in the head-side aggregate ring); oldest finished records evict first"),
     "llm_slo_ttft_ms": (float, 200.0, "time-to-first-token SLO target; llm_slo_ttft_attainment reports the fraction of finished requests under it"),
     "llm_slo_tpot_ms": (float, 20.0, "time-per-output-token SLO target (mean inter-token latency after the first); llm_slo_tpot_attainment reports attainment"),
+    # --- serving control loop (serve/controller.py 'slo' policy) ---
+    "serve_slo_window_s": (float, 10.0, "sliding window of finished requests the SLO autoscaling policy evaluates attainment over (too short: scale thrash on noise; too long: slow reflexes)"),
+    "serve_slo_target_attainment": (float, 0.95, "fraction of windowed requests that must meet BOTH llm_slo_ttft_ms and llm_slo_tpot_ms; below target scales replicas up, sustained above (with headroom) drains down"),
+    "serve_slo_eval_period_s": (float, 1.0, "SLO policy evaluation period (controller reconcile passes between policy decisions are a no-op)"),
+    "serve_slo_scale_down_evals": (int, 10, "consecutive over-target evaluations (with attainment headroom at n-1 replicas) before a drain-and-pack scale-down; hysteresis against diurnal noise"),
+    "serve_overload_steps": (int, 3, "consecutive below-target evaluations AT max replicas before the degradation ladder escalates one level (admission tightening, then shedding)"),
+    "serve_overload_budget_factor": (float, 0.5, "per-level multiplier applied to llm_step_token_budget while overloaded: level n runs at budget*factor**n (tighter admission keeps decode TPOT alive at the cost of prefill throughput)"),
+    "serve_overload_max_level": (int, 3, "degradation ladder ceiling; at max level with a configured shed model, excess requests re-route to the cheaper model via multiplex routing (overload_shed_total counts them)"),
+    # --- instance lifecycle (runtime/instance_manager.py) ---
+    "instance_orphan_grace_s": (float, 15.0, "restart reconcile terminates a REQUESTED/ALLOCATED instance whose node never registered only after this age — younger launches may still be booting and get adopted instead (raise well above slice boot time for cloud providers)"),
     # --- misc ---
     "session_dir": (str, "/tmp/ray_tpu", "root for session artifacts"),
     "log_to_driver": (bool, True, "forward worker logs to driver"),

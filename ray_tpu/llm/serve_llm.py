@@ -321,6 +321,28 @@ class LLMServer:
             return []
         return self.engine.request_log.snapshot()
 
+    def set_overload_level(self, level: int,
+                           budget_factor: float = 0.5) -> int:
+        """Degradation ladder hook, invoked by the serve controller's SLO
+        policy: level n runs the engine at step_token_budget *
+        budget_factor**n — tighter prefill admission keeps decode TPOT
+        alive for already-admitted requests at the cost of new-request
+        TTFT. Level 0 restores the configured budget. Returns the
+        effective budget (an unbounded base budget of 0 degrades from
+        the config default so level>0 always tightens something)."""
+        if not hasattr(self, "_base_token_budget"):
+            self._base_token_budget = self.engine.step_token_budget
+        level = max(0, int(level))
+        if level == 0:
+            self.engine.step_token_budget = self._base_token_budget
+        else:
+            from ray_tpu.core.config import GlobalConfig
+            base = self._base_token_budget or \
+                GlobalConfig.llm_step_token_budget or 2048
+            self.engine.step_token_budget = max(
+                64, int(base * (budget_factor ** level)))
+        return self.engine.step_token_budget
+
     def check_health(self) -> None:
         if not self._thread.is_alive():
             raise RuntimeError("engine thread died")

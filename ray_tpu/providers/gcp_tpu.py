@@ -137,11 +137,20 @@ class TpuVmNodeProvider(NodeProvider):
     def _parent(self) -> str:
         return f"{_TPU_API}/projects/{self.project}/locations/{self.zone}"
 
-    def create_node(self, resources: Dict[str, float]) -> _SliceHandle:
+    def _name_for(self, node_id: str) -> str:
+        """Deterministic resource name for a node identity — what lets a
+        restarted autoscaler terminate an orphaned slice from nothing
+        but the persisted instance record."""
+        return f"{self.name_prefix}-{node_id[:12]}"
+
+    def create_node(self, resources: Dict[str, float],
+                    node_id: Optional[str] = None) -> _SliceHandle:
         from ray_tpu.core.ids import NodeID
         from ray_tpu.core import config as config_mod
-        node_id = NodeID.from_random().hex()
-        name = f"{self.name_prefix}-{node_id[:12]}"
+        from ray_tpu.util.fault_injector import fire
+        fire("provider.create")
+        node_id = node_id or NodeID.from_random().hex()
+        name = self._name_for(node_id)
         startup = _STARTUP_TEMPLATE.format(
             head_addr=self.head_addr, session=self.session,
             node_id=node_id, config=config_mod.GlobalConfig.to_json())
@@ -160,11 +169,49 @@ class TpuVmNodeProvider(NodeProvider):
                             self.http)
 
     def terminate_node(self, handle: _SliceHandle) -> None:
+        from ray_tpu.util.fault_injector import fire
+        fire("provider.terminate")
         logger.info("releasing TPU slice %s", handle.name.rsplit("/", 1)[-1])
         try:
             self.http.request("DELETE", handle.name)
         except Exception:  # noqa: BLE001 — already gone / API hiccup;
             logger.exception("slice delete failed: %s", handle.name)
+
+    def describe(self, handle: _SliceHandle) -> Dict[str, Any]:
+        return {"name": handle.name}
+
+    def list_live(self) -> Dict[str, Dict[str, Any]]:
+        """The provider's live-handle ledger: every not-yet-deleted slice
+        in this session, keyed by the rtpu-node-id label it was created
+        with — the substrate restart reconcile converges against."""
+        try:
+            nodes = self.http.request(
+                "GET", f"{self._parent}/nodes").get("nodes", [])
+        except Exception:  # noqa: BLE001 — API down: report nothing
+            logger.exception("TPU node list failed")
+            return {}
+        out: Dict[str, Dict[str, Any]] = {}
+        for n in nodes:
+            labels = n.get("labels") or {}
+            if labels.get("rtpu-session") != self.session:
+                continue
+            nid = labels.get("rtpu-node-id")
+            if nid and n.get("state") not in ("DELETING", "TERMINATED"):
+                out[nid] = {"name": n.get("name", "")}
+        return out
+
+    def terminate_orphan(self, node_id: str,
+                         metadata: Dict[str, Any]) -> None:
+        from ray_tpu.util.fault_injector import fire
+        fire("provider.terminate")
+        name = metadata.get("name") or \
+            f"{self._parent}/nodes/{self._name_for(node_id)}"
+        logger.info("releasing orphaned TPU slice %s",
+                    name.rsplit("/", 1)[-1])
+        try:
+            self.http.request("DELETE", name)
+        except Exception:  # noqa: BLE001 — already gone
+            logger.exception("orphan slice delete failed: %s", name)
 
     @staticmethod
     def slice_node_type(accelerator_type: str,

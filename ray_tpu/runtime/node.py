@@ -906,6 +906,10 @@ def main() -> None:
     args = json.loads(sys.argv[3])
     if args.get("config"):
         config_mod.GlobalConfig.apply(args["config"])
+    # chaos seam: lets lifecycle tests model a node that dies before it
+    # ever registers (stillborn launch)
+    from ray_tpu.util.fault_injector import fire
+    fire("node.boot")
     daemon = NodeDaemon(
         head_addr, session,
         resources=args.get("resources") or {"CPU": float(os.cpu_count() or 1)},

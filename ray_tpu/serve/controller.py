@@ -8,6 +8,19 @@ actor holds target state per deployment; a reconcile thread converges
 actual replica actors to the target (start missing, stop extra, replace
 dead) and adjusts the target from observed queue lengths when an
 autoscaling config is present.
+
+Two autoscaling policies:
+
+* the default queue policy (``target_ongoing_requests``), and
+* ``policy: "slo"`` — the serving control loop: windowed TTFT/TPOT SLO
+  attainment (read from the head's request table, fed by the engines'
+  flight recorders) drives replica count up on breach and drains down on
+  sustained headroom; when attainment keeps falling AT max replicas a
+  degradation ladder tightens engine admission (``set_overload_level``
+  scales ``llm_step_token_budget`` down per level) and finally sheds
+  requests to a cheaper multiplexed model via the routing table's
+  ``shed_to`` field. Every decision is journaled into the head's
+  ClusterEventJournal so ``events --follow`` replays a whole storm.
 """
 
 from __future__ import annotations
@@ -26,6 +39,29 @@ logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "__serve_controller__"
 SERVE_NAMESPACE = "serve"
+
+
+def windowed_attainment(records: List[dict], now_wall: float,
+                        window_s: float, ttft_target_s: float,
+                        tpot_target_s: float) -> "tuple[float, int]":
+    """(attainment, n) over flight-recorder request records (wire dicts
+    from the head's ``requests_dump``) that FINISHED within the trailing
+    window. A request attains when its TTFT meets the target AND its
+    TPOT (when it produced >1 token) does too. No finished traffic in
+    the window reads as 1.0 — an idle service is not in breach."""
+    n = met = 0
+    for r in records:
+        if not r.get("done"):
+            continue
+        t0, e2e = r.get("t0_wall"), r.get("e2e")
+        if t0 is None or e2e is None or t0 + e2e < now_wall - window_s:
+            continue
+        n += 1
+        ttft, tpot = r.get("ttft"), r.get("tpot")
+        if (ttft is None or ttft <= ttft_target_s) and \
+                (tpot is None or tpot <= tpot_target_s):
+            met += 1
+    return (met / n if n else 1.0), n
 
 
 class _DeploymentState:
@@ -49,6 +85,12 @@ class _DeploymentState:
         self.consecutive_failures = 0
         self.backoff_until = 0.0
         self.unhealthy_reason: Optional[str] = None
+        # SLO control-loop state (autoscaling_config policy == "slo")
+        self.overload_level = 0          # degradation ladder position
+        self.shed_to = ""                # routing-table shed target
+        self.slo_breach_streak = 0       # consecutive breaches AT max
+        self.slo_ok_streak = 0           # consecutive over-target evals
+        self.last_slo_eval = 0.0
 
 
 class ServeController:
@@ -158,7 +200,8 @@ class ServeController:
             st = self._deployments.get(name)
             if st is None:
                 return {"version": -1, "replicas": []}
-            return {"version": st.version, "replicas": list(st.replicas)}
+            return {"version": st.version, "replicas": list(st.replicas),
+                    "shed_to": st.shed_to}
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
@@ -175,6 +218,8 @@ class ServeController:
                     "version": st.version,
                     "deleted": st.deleted,
                     "unhealthy_reason": st.unhealthy_reason,
+                    "overload_level": st.overload_level,
+                    "shed_to": st.shed_to,
                 } for name, st in self._deployments.items()}
 
     def set_app(self, app: str, names: List[str]) -> List[str]:
@@ -426,6 +471,9 @@ class ServeController:
         cfg = st.spec.get("autoscaling_config")
         if not cfg or st.deleted or not st.replicas:
             return
+        if cfg.get("policy") == "slo":
+            self._autoscale_slo(st, cfg)
+            return
         now = time.monotonic()
         if now - st.last_scale_ts < cfg.get("upscale_delay_s", 1.0):
             return
@@ -460,3 +508,155 @@ class ServeController:
                         st.name, st.target_replicas, desired, total_ongoing)
             st.target_replicas = desired
             st.last_scale_ts = now
+
+    # ------------------------------------------------- SLO control loop
+
+    def _head_client(self):
+        """The head RpcClient of the worker this controller actor runs
+        in — the path to the request table (requests_dump) and the
+        cluster event journal (journal_record)."""
+        from ray_tpu.core.worker import global_worker
+        return global_worker.backend.head
+
+    def _journal(self, etype: str, **fields) -> None:
+        """Best-effort control-loop decision record in the head's event
+        journal — `events --follow` replays a storm from these."""
+        try:
+            self._head_client().call("journal_record",
+                                     {"type": etype, **fields}, timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _autoscale_slo(self, st: _DeploymentState, cfg: dict) -> None:
+        """The SLO reflex arc, one evaluation per serve_slo_eval_period_s:
+
+        attainment < target, below max  -> +1 replica (scale out beats
+                                           degrading)
+        attainment < target AT max      -> after overload_steps straight
+                                           breaches, climb the ladder:
+                                           tighten engine admission one
+                                           level; at the top, shed to the
+                                           cheaper ``shed_model_id``
+        attainment >= target            -> unwind shedding, then the
+                                           ladder, one level per eval;
+                                           then after scale_down_evals of
+                                           sustained headroom, drain one
+                                           replica (graceful: victims
+                                           leave the routing table and
+                                           finish in-flight first)
+        """
+        from ray_tpu.core.config import GlobalConfig
+        now = time.monotonic()
+        period = cfg.get("slo_eval_period_s",
+                         GlobalConfig.serve_slo_eval_period_s)
+        if now - st.last_slo_eval < period:
+            return
+        st.last_slo_eval = now
+        window = cfg.get("slo_window_s", GlobalConfig.serve_slo_window_s)
+        try:
+            records = self._head_client().call("requests_dump", {},
+                                               timeout=5) or []
+        except Exception:  # noqa: BLE001 — no signal, no decision
+            return
+        attainment, n = windowed_attainment(
+            records, time.time(), window,
+            GlobalConfig.llm_slo_ttft_ms / 1e3,
+            GlobalConfig.llm_slo_tpot_ms / 1e3)
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+            metrics_mod.serve_slo_attainment_gauge().set(
+                attainment, tags={"deployment": st.name})
+        except Exception:  # noqa: BLE001
+            pass
+        target = cfg.get("target_attainment",
+                         GlobalConfig.serve_slo_target_attainment)
+        min_r, max_r = cfg.get("min_replicas", 1), cfg.get("max_replicas", 8)
+        if attainment < target:
+            st.slo_ok_streak = 0
+            self._journal("serve_slo_breach", deployment=st.name,
+                          attainment=round(attainment, 4), target=target,
+                          window_n=n, replicas=st.target_replicas,
+                          overload_level=st.overload_level)
+            if st.target_replicas < max_r:
+                st.slo_breach_streak = 0
+                st.target_replicas += 1
+                st.last_scale_ts = now
+                logger.info("serve slo %s: scale up to %d "
+                            "(attainment %.3f < %.3f)", st.name,
+                            st.target_replicas, attainment, target)
+                self._journal("serve_autoscale", deployment=st.name,
+                              direction="up", to=st.target_replicas,
+                              reason="slo_attainment",
+                              attainment=round(attainment, 4))
+                return
+            # at max replicas: degrade instead of queue collapse
+            st.slo_breach_streak += 1
+            steps = cfg.get("overload_steps",
+                            GlobalConfig.serve_overload_steps)
+            max_level = cfg.get("overload_max_level",
+                                GlobalConfig.serve_overload_max_level)
+            if st.slo_breach_streak < steps:
+                return
+            st.slo_breach_streak = 0
+            if st.overload_level < max_level:
+                self._set_overload(st, cfg, st.overload_level + 1)
+            elif cfg.get("shed_model_id") and not st.shed_to:
+                with self._lock:
+                    st.shed_to = cfg["shed_model_id"]
+                    self._bump_version(st)
+                logger.warning("serve slo %s: shedding to %s", st.name,
+                               st.shed_to)
+                self._journal("serve_overload_shed_on",
+                              deployment=st.name, shed_to=st.shed_to)
+            return
+        # over target: recover — unwind the ladder before packing down
+        st.slo_breach_streak = 0
+        if st.shed_to:
+            with self._lock:
+                st.shed_to = ""
+                self._bump_version(st)
+            self._journal("serve_overload_shed_off", deployment=st.name,
+                          attainment=round(attainment, 4))
+            return
+        if st.overload_level > 0:
+            self._set_overload(st, cfg, st.overload_level - 1)
+            if st.overload_level == 0:
+                self._journal("serve_slo_recovered", deployment=st.name,
+                              attainment=round(attainment, 4))
+            return
+        st.slo_ok_streak += 1
+        down_evals = cfg.get("scale_down_evals",
+                             GlobalConfig.serve_slo_scale_down_evals)
+        if st.slo_ok_streak >= down_evals and st.target_replicas > min_r:
+            st.slo_ok_streak = 0
+            st.target_replicas -= 1
+            st.last_scale_ts = now
+            logger.info("serve slo %s: drain down to %d (sustained "
+                        "headroom)", st.name, st.target_replicas)
+            self._journal("serve_autoscale", deployment=st.name,
+                          direction="down", to=st.target_replicas,
+                          reason="slo_headroom",
+                          attainment=round(attainment, 4))
+
+    def _set_overload(self, st: _DeploymentState, cfg: dict,
+                      level: int) -> None:
+        """Move the degradation ladder and push the admission budget to
+        every replica (fire-and-forget generic method dispatch — a
+        callable without set_overload_level just raises replica-side and
+        the request is dropped there)."""
+        from ray_tpu.core.config import GlobalConfig
+        level = max(0, level)
+        if level == st.overload_level:
+            return
+        factor = cfg.get("overload_budget_factor",
+                         GlobalConfig.serve_overload_budget_factor)
+        st.overload_level = level
+        logger.warning("serve slo %s: overload level -> %d", st.name, level)
+        self._journal("serve_overload_level", deployment=st.name,
+                      level=level, budget_factor=factor)
+        for h in list(st.replicas):
+            try:
+                h.handle_request.remote("set_overload_level",
+                                        (level, factor), {})
+            except Exception:  # noqa: BLE001
+                pass

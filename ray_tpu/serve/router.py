@@ -28,6 +28,24 @@ from ray_tpu.util import trace_context
 #: subscribe (single definition; controller.py imports it)
 ROUTE_TOPIC = "serve:routes"
 
+#: replica-death retry policy (result()/streaming pre-first-item): full-
+#: jitter exponential backoff, bounded BOTH by attempt count and by a
+#: total deadline — a dead deployment fails fast instead of the old
+#: fixed-interval hammering, and a flapping one spreads its retries out
+RETRY_MAX_ATTEMPTS = 4
+RETRY_BASE_S = 0.05
+RETRY_CAP_S = 2.0
+RETRY_DEADLINE_S = 15.0
+
+
+def backoff_delay(attempt: int, base: float = RETRY_BASE_S,
+                  cap: float = RETRY_CAP_S) -> float:
+    """Full-jitter exponential backoff: uniform in [0, min(cap,
+    base*2^attempt)] — jitter over the WHOLE interval so synchronized
+    failures (a replica death seen by every caller at once) decorrelate
+    instead of retrying in lockstep."""
+    return random.uniform(0.0, min(cap, base * (2.0 ** attempt)))
+
 
 class _RouteListener:
     """Process-wide subscriber to the controller's routing pushes
@@ -135,20 +153,24 @@ class DeploymentResponse:
     before replying (routing tables are refreshed lazily, so a request can
     race a replica death for up to TABLE_MAX_AGE_S) — the reference's
     replica-scheduler failover, moved to result time because submission
-    here never fails synchronously."""
+    here never fails synchronously. Retries back off exponentially with
+    full jitter, bounded by RETRY_MAX_ATTEMPTS and RETRY_DEADLINE_S."""
 
     def __init__(self, ref, retry=None, note=None):
         self._ref = ref
         self._retry = retry
-        # note(outcome): router latency observation for non-ok endings
-        # ("timeout"/"retry"/"error") — the ok path is observed by the
-        # router's reaper when the reply lands, so without this the
-        # latency histogram silently excluded exactly the worst requests
-        self._note = note if note is not None else (lambda outcome: None)
+        # note(outcome, attempt): router latency observation for non-ok
+        # endings ("timeout"/"retry"/"error") — the ok path is observed
+        # by the router's reaper when the reply lands, so without this
+        # the latency histogram silently excluded exactly the worst
+        # requests. attempt tags which retry round observed.
+        self._note = note if note is not None else (
+            lambda outcome, attempt=0: None)
 
     def result(self, timeout: Optional[float] = 30.0) -> Any:
         from ray_tpu.exceptions import ActorError, GetTimeoutError
-        attempts = 3
+        attempt = 0
+        deadline = time.monotonic() + RETRY_DEADLINE_S
         while True:
             try:
                 return ray_tpu.get(self._ref, timeout=timeout)
@@ -156,14 +178,17 @@ class DeploymentResponse:
                 # the replica may still complete later (the reaper then
                 # observes outcome="ok" for the landed reply); this
                 # sample records that the CALLER gave up at `timeout`
-                self._note("timeout")
+                self._note("timeout", attempt)
                 raise
             except ActorError:
-                attempts -= 1
-                if self._retry is None or attempts <= 0:
-                    self._note("error")
+                attempt += 1
+                delay = backoff_delay(attempt - 1)
+                if self._retry is None or attempt >= RETRY_MAX_ATTEMPTS \
+                        or time.monotonic() + delay >= deadline:
+                    self._note("error", attempt - 1)
                     raise
-                self._note("retry")
+                self._note("retry", attempt)
+                time.sleep(delay)
                 self._ref = self._retry()
 
     @property
@@ -184,9 +209,12 @@ class DeploymentResponseGenerator:
         self._done = False
         self._retry = retry
         self._yielded = False
-        # note(outcome): first call wins (router-side latch) — error
-        # paths stamp their outcome BEFORE _finish's default "ok"
-        self._note = note if note is not None else (lambda outcome: None)
+        self._attempt = 0
+        self._deadline = time.monotonic() + RETRY_DEADLINE_S
+        # note(outcome, attempt): first call wins (router-side latch) —
+        # error paths stamp their outcome BEFORE _finish's default "ok"
+        self._note = note if note is not None else (
+            lambda outcome, attempt=0: None)
 
     def __iter__(self):
         return self
@@ -200,25 +228,30 @@ class DeploymentResponseGenerator:
             self._finish()          # stream end: observes outcome="ok"
             raise
         except GetTimeoutError:
-            self._note("timeout")
+            self._note("timeout", self._attempt)
             self._finish()
             raise
         except ActorError:
             # replica died BEFORE producing anything: safe to re-route
             # (once items flowed, replaying could duplicate side effects)
-            if self._yielded or self._retry is None:
-                self._note("error")
+            self._attempt += 1
+            delay = backoff_delay(self._attempt - 1)
+            if self._yielded or self._retry is None \
+                    or self._attempt >= RETRY_MAX_ATTEMPTS \
+                    or time.monotonic() + delay >= self._deadline:
+                self._note("error", max(0, self._attempt - 1))
                 self._finish()
                 raise
-            self._note("retry")
+            self._note("retry", self._attempt)
             self._finish()
+            time.sleep(delay)
             fresh = self._retry()
             self._gen, self._on_done = fresh._gen, fresh._on_done
             self._note = fresh._note
-            self._done, self._retry = False, None
+            self._done = False
             return next(self)
         except BaseException:
-            self._note("error")
+            self._note("error", self._attempt)
             self._finish()
             raise
         self._yielded = True
@@ -253,6 +286,10 @@ class Router:
         self._replicas: list = []
         self._version = -1
         self._fetched_at = 0.0
+        # overload shed target published by the controller's degradation
+        # ladder ("" = no shedding): requests re-route to this cheaper
+        # multiplexed model until the table clears it
+        self._shed_to = ""
         self._inflight: Dict[str, int] = {}  # replica actor id hex -> count
         self._pending: list = []   # [(key, ref, t0)] awaiting completion
         self._pending_cv = threading.Condition(self._lock)
@@ -297,6 +334,7 @@ class Router:
                 # forever (advisor r2 slow leak)
                 self._pending = [(k, r, t0) for k, r, t0 in self._pending
                                  if k in live]
+            self._shed_to = table.get("shed_to", "")
             self._fetched_at = now
 
     # a model-holding replica is preferred until its queue exceeds the
@@ -343,17 +381,35 @@ class Router:
                     seen.pop(min(seen, key=seen.get))
             return chosen
 
+    def _apply_shed(self, model_id: str) -> str:
+        """Overload shedding: when the controller published a shed
+        target, re-route this request to the cheaper model (multiplex
+        routing does the rest) and count it — unless the caller already
+        asked for that model."""
+        shed = self._shed_to
+        if not shed or model_id == shed:
+            return model_id
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+            metrics_mod.serve_overload_shed_total_counter().inc(
+                tags={"deployment": self._name})
+        except Exception:  # noqa: BLE001
+            pass
+        return shed
+
     def _note_metrics(self, latency_s: float = -1.0,
-                      outcome: str = "ok") -> None:
+                      outcome: str = "ok", attempt: int = 0) -> None:
         """Built-in serve metrics (L5 source wiring): the inflight gauge
         tracks this router's total outstanding count; completions observe
         the per-deployment latency histogram, tagged with the request
-        outcome (ok/timeout/retry/error) so p99 includes the worst cases
-        instead of silently excluding them. Registered lazily and
-        swallowed on failure — routing must never depend on telemetry."""
+        outcome (ok/timeout/retry/error) and — for retry rounds — the
+        attempt number, so p99 includes the worst cases instead of
+        silently excluding them. Registered lazily and swallowed on
+        failure — routing must never depend on telemetry."""
         try:
             from ray_tpu.util import metrics as metrics_mod
-            tags = {"deployment": self._name, "outcome": outcome}
+            tags = {"deployment": self._name, "outcome": outcome,
+                    "attempt": str(attempt) if attempt else ""}
             with self._lock:
                 total = sum(self._inflight.values())
             # the gauge's tag_keys filter drops the outcome key
@@ -368,9 +424,10 @@ class Router:
                         model_id: str = "") -> DeploymentResponseGenerator:
         """Streamed call: items become consumable as the replica yields
         them (rides num_returns='streaming' actor methods)."""
+        self._refresh()
+        model_id = self._apply_shed(model_id)
         if model_id:
             kwargs = {**kwargs, MUX_KWARG: model_id}
-        self._refresh()
         replica = self._pick(model_id)
         if replica is None:
             self._refresh(force=True)
@@ -385,14 +442,14 @@ class Router:
         t0 = time.monotonic()
         observed = [False]
 
-        def note(outcome: str) -> None:
+        def note(outcome: str, attempt: int = 0) -> None:
             # one latency observation per attempt: timeout/retry/error
             # paths stamp their outcome first; stream end lands "ok"
             if observed[0]:
                 return
             observed[0] = True
             self._note_metrics(latency_s=time.monotonic() - t0,
-                               outcome=outcome)
+                               outcome=outcome, attempt=attempt)
 
         def done():
             with self._lock:
@@ -428,11 +485,11 @@ class Router:
             self._refresh(force=True)
             return self._submit(method_name, args, kwargs, model_id)
 
-        def note(outcome: str) -> None:
+        def note(outcome: str, attempt: int = 0) -> None:
             # non-ok endings seen at result() time; the ok path is
             # observed by the reaper when the reply lands
             self._note_metrics(latency_s=time.monotonic() - t0,
-                               outcome=outcome)
+                               outcome=outcome, attempt=attempt)
         return DeploymentResponse(ref, retry=retry, note=note)
 
     def _traced_remote(self, method_name: str, submit):
@@ -474,9 +531,10 @@ class Router:
 
     def _submit(self, method_name: str, args: tuple, kwargs: dict,
                 model_id: str = ""):
+        self._refresh()
+        model_id = self._apply_shed(model_id)
         if model_id:
             kwargs = {**kwargs, MUX_KWARG: model_id}
-        self._refresh()
         replica = self._pick(model_id)
         if replica is None:
             self._refresh(force=True)

@@ -193,11 +193,13 @@ def serve_request_latency_histogram() -> Histogram:
     seconds, observed caller-side so it includes queueing + transport).
     Tagged with the request outcome (ok/timeout/retry/error) so p99
     stops silently excluding the worst cases: timed-out and retried
-    requests observe too."""
+    requests observe too — and with the retry attempt number (""
+    for first tries), so a backoff storm is visible as an attempt
+    distribution rather than a mush of retry latencies."""
     return Histogram(
         "serve_request_latency_s",
         description="seconds from router submit to replica reply",
-        tag_keys=("deployment", "outcome"))
+        tag_keys=("deployment", "outcome", "attempt"))
 
 
 def serve_inflight_gauge() -> Gauge:
@@ -206,6 +208,28 @@ def serve_inflight_gauge() -> Gauge:
     replicas)."""
     return Gauge("serve_inflight_requests",
                  description="in-flight requests per deployment",
+                 tag_keys=("deployment",))
+
+
+def serve_overload_shed_total_counter() -> Counter:
+    """Requests re-routed to the cheaper shed model by the overload
+    degradation ladder (serve/controller.py 'slo' policy at max level).
+    A non-zero rate is the signature of a storm survived by degrading
+    instead of queue collapse."""
+    return Counter("serve_overload_shed_total",
+                   description="requests shed to the overload fallback "
+                               "model",
+                   tag_keys=("deployment",))
+
+
+def serve_slo_attainment_gauge() -> Gauge:
+    """Windowed SLO attainment the serving control loop last acted on
+    (fraction of finished requests in serve_slo_window_s meeting both
+    TTFT and TPOT targets) — the controller-side view, distinct from the
+    engine-lifetime llm_slo_*_attainment gauges."""
+    return Gauge("serve_slo_attainment",
+                 description="windowed fraction of requests meeting both "
+                             "latency SLOs (0..1)",
                  tag_keys=("deployment",))
 
 

@@ -34,6 +34,20 @@ def pytest_configure(config):
         "markers", "pallas_interpret: Pallas TPU kernel tests that run "
         "in interpret mode on the tier-1 CPU sweep (JAX_PLATFORMS=cpu) "
         "— same kernel logic, emulated lowering")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection lifecycle tests driven via "
+        "ray_tpu.util.fault_injector (RTPU_FAULT_INJECT hook points)")
+
+
+@pytest.fixture
+def fault_injector():
+    """Armed-and-disarmed FaultInjector access: yields the module, then
+    resets the point table and env var in teardown so chaos specs never
+    leak into the next test."""
+    from ray_tpu.util import fault_injector as fi
+    yield fi
+    fi.reset()
+    os.environ.pop(fi.ENV_VAR, None)
 
 
 @pytest.fixture

@@ -1,0 +1,475 @@
+"""SLO-driven serving control loop tests (reference scope: serve
+autoscaling_policy tests + the PR-11 tentpole's serving control loop).
+
+Covers: windowed attainment math, the router's bounded full-jitter retry
+backoff with attempt-tagged latency observations, the degradation ladder
+(engine admission tightening + shed-to-cheaper-model routing), graceful
+scale-down draining in-flight requests, and the diurnal-load soak whose
+recovery is asserted against the cluster event journal.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+from ray_tpu.serve.controller import (ServeController, _DeploymentState,
+                                      windowed_attainment)
+from ray_tpu.serve.router import (RETRY_BASE_S, RETRY_CAP_S,
+                                  RETRY_MAX_ATTEMPTS, DeploymentResponse,
+                                  Router, backoff_delay)
+
+
+# ----------------------------------------------------------- unit: window
+
+
+def test_windowed_attainment():
+    now = 1000.0
+
+    def rec(done=True, finished_at=999.0, ttft=0.01, tpot=0.001,
+            dur=0.5):
+        return {"done": done, "t0_wall": finished_at - dur, "e2e": dur,
+                "ttft": ttft, "tpot": tpot}
+
+    # all inside the window and under target
+    a, n = windowed_attainment([rec(), rec()], now, 10.0, 0.2, 0.02)
+    assert (a, n) == (1.0, 2)
+    # ttft breach and tpot breach each fail the request
+    a, n = windowed_attainment(
+        [rec(), rec(ttft=5.0), rec(tpot=5.0)], now, 10.0, 0.2, 0.02)
+    assert n == 3 and a == pytest.approx(1 / 3)
+    # finished outside the window / still in flight: not counted
+    a, n = windowed_attainment(
+        [rec(finished_at=900.0, ttft=5.0), rec(done=False, ttft=5.0)],
+        now, 10.0, 0.2, 0.02)
+    assert (a, n) == (1.0, 0)
+    # a 1-token request has no TPOT: only TTFT judges it
+    a, n = windowed_attainment([rec(tpot=None)], now, 10.0, 0.2, 0.02)
+    assert (a, n) == (1.0, 1)
+
+
+# ---------------------------------------------------- unit: router backoff
+
+
+def test_backoff_delay_full_jitter_bounds():
+    for attempt in range(12):
+        for _ in range(50):
+            d = backoff_delay(attempt)
+            assert 0.0 <= d <= min(RETRY_CAP_S,
+                                   RETRY_BASE_S * 2 ** attempt)
+    # the cap bounds even absurd attempt counts (no float overflow blowup)
+    assert backoff_delay(500) <= RETRY_CAP_S
+
+
+def test_result_retries_bounded_with_attempt_tags(monkeypatch):
+    """Replica-death retries are bounded by RETRY_MAX_ATTEMPTS, back off
+    between rounds, and tag every latency observation with the attempt
+    number — the old behavior was unbounded fixed-interval hammering."""
+    from ray_tpu.exceptions import ActorError
+
+    calls = {"get": 0, "retry": 0}
+
+    def dead_get(ref, timeout=None):
+        calls["get"] += 1
+        raise ActorError("replica died")
+
+    monkeypatch.setattr(rt, "get", dead_get)
+    notes = []
+    resp = DeploymentResponse(
+        object(), retry=lambda: (calls.__setitem__(
+            "retry", calls["retry"] + 1), object())[1],
+        note=lambda outcome, attempt=0: notes.append((outcome, attempt)))
+    t0 = time.monotonic()
+    with pytest.raises(ActorError):
+        resp.result(timeout=5)
+    elapsed = time.monotonic() - t0
+    assert calls["get"] == RETRY_MAX_ATTEMPTS
+    assert calls["retry"] == RETRY_MAX_ATTEMPTS - 1
+    # retry rounds observed with their attempt number; the terminal
+    # failure observed as outcome="error"
+    assert notes[:-1] == [("retry", i)
+                          for i in range(1, RETRY_MAX_ATTEMPTS)]
+    assert notes[-1] == ("error", RETRY_MAX_ATTEMPTS - 1)
+    # it actually backed off (sum of three full-jitter draws is >0 with
+    # overwhelming probability, and bounded by the un-jittered sum)
+    assert elapsed <= sum(min(RETRY_CAP_S, RETRY_BASE_S * 2 ** a)
+                          for a in range(RETRY_MAX_ATTEMPTS)) + 1.0
+
+
+def test_router_apply_shed_counts(monkeypatch):
+    from ray_tpu.util import metrics as metrics_mod
+
+    router = Router.__new__(Router)
+    router._name = "shedder"
+    router._shed_to = ""
+    assert router._apply_shed("") == ""
+    assert router._apply_shed("big-model") == "big-model"
+    router._shed_to = "tiny"
+    before = sum(metrics_mod.snapshot().get(
+        "serve_overload_shed_total", {}).get("values", {}).values())
+    assert router._apply_shed("big-model") == "tiny"
+    assert router._apply_shed("") == "tiny"
+    # a caller already on the shed target is not re-shed (or re-counted)
+    assert router._apply_shed("tiny") == "tiny"
+    after = sum(metrics_mod.snapshot().get(
+        "serve_overload_shed_total", {}).get("values", {}).values())
+    assert after == before + 2
+
+
+# ------------------------------------------------- unit: degradation ladder
+
+
+def test_set_overload_level_scales_token_budget():
+    from types import SimpleNamespace
+
+    from ray_tpu.llm.serve_llm import LLMServer
+    srv = SimpleNamespace(engine=SimpleNamespace(step_token_budget=2048))
+    assert LLMServer.set_overload_level(srv, 1, 0.5) == 1024
+    assert LLMServer.set_overload_level(srv, 2, 0.5) == 512
+    assert LLMServer.set_overload_level(srv, 0) == 2048  # restore base
+    # an unbounded base budget (0) still tightens, from the config default
+    srv2 = SimpleNamespace(engine=SimpleNamespace(step_token_budget=0))
+    assert 64 <= LLMServer.set_overload_level(srv2, 1, 0.5) < 2048
+    assert LLMServer.set_overload_level(srv2, 0) == 0
+
+
+class _FakeHead:
+    def __init__(self):
+        self.records = []
+
+    def call(self, method, payload, timeout=None):
+        assert method == "requests_dump"
+        return list(self.records)
+
+
+class _FakeReplica:
+    def __init__(self):
+        self.pushes = []
+        outer = self
+
+        class _M:
+            def remote(self, method, args, kwargs):
+                outer.pushes.append((method, args))
+
+        self.handle_request = _M()
+
+
+def _mk_controller(st):
+    import collections
+    ctrl = ServeController.__new__(ServeController)
+    ctrl._lock = threading.RLock()
+    ctrl._route_events = collections.deque()
+    ctrl._route_kick = threading.Event()
+    ctrl._deployments = {st.name: st}
+    return ctrl
+
+
+def _recs(ttft, n=5):
+    now = time.time()
+    return [{"done": True, "t0_wall": now - 0.2, "e2e": 0.1,
+             "ttft": ttft, "tpot": 0.001} for _ in range(n)]
+
+
+def test_slo_policy_ladder_and_shed_state_machine():
+    """Drive _autoscale_slo through a full storm and recovery: scale out
+    first, then climb the degradation ladder at max replicas, shed at the
+    top, and unwind everything in reverse on sustained headroom."""
+    st = _DeploymentState("llm", {"num_replicas": 1})
+    replica = _FakeReplica()
+    st.replicas = [replica]
+    ctrl = _mk_controller(st)
+    head = _FakeHead()
+    journal = []
+    ctrl._head_client = lambda: head
+    ctrl._journal = lambda etype, **f: journal.append((etype, f))
+    cfg = {"policy": "slo", "min_replicas": 1, "max_replicas": 2,
+           "slo_eval_period_s": 0.0, "slo_window_s": 60.0,
+           "target_attainment": 0.9, "overload_steps": 2,
+           "overload_max_level": 2, "overload_budget_factor": 0.5,
+           "scale_down_evals": 2, "shed_model_id": "cheap"}
+
+    def step():
+        ctrl._autoscale_slo(st, cfg)
+
+    head.records = _recs(ttft=10.0)        # hard breach
+    step()                                  # below max: scale out
+    assert st.target_replicas == 2
+    assert ("serve_autoscale" in [e for e, _ in journal])
+    step()                                  # at max: streak 1, no action
+    assert st.overload_level == 0
+    step()                                  # streak 2 -> ladder level 1
+    assert st.overload_level == 1
+    step(); step()                          # streak 2 again -> level 2
+    assert st.overload_level == 2
+    v_before = st.version
+    step(); step()                          # at top -> shed engages
+    assert st.shed_to == "cheap" and st.version > v_before
+    # replicas got the admission pushes (fire-and-forget dispatch)
+    assert [a for m, a in replica.pushes
+            if m == "set_overload_level"] == [(1, 0.5), (2, 0.5)]
+    # the shed target reaches routers through the routing table
+    assert ctrl.get_routing_table("llm")["shed_to"] == "cheap"
+
+    head.records = _recs(ttft=0.001)       # recovered traffic
+    step()                                  # unwind shed first
+    assert st.shed_to == "" and st.overload_level == 2
+    step(); step()                          # ladder 2 -> 1 -> 0
+    assert st.overload_level == 0
+    assert [a for m, a in replica.pushes
+            if m == "set_overload_level"][-2:] == [(1, 0.5), (0, 0.5)]
+    step(); step()                          # 2 ok evals -> drain one
+    assert st.target_replicas == 1
+
+    types = [e for e, _ in journal]
+    for expected in ("serve_slo_breach", "serve_autoscale",
+                     "serve_overload_level", "serve_overload_shed_on",
+                     "serve_overload_shed_off", "serve_slo_recovered"):
+        assert expected in types, (expected, types)
+    # the storm replays in causal order from the journal alone
+    assert types.index("serve_overload_shed_on") \
+        < types.index("serve_overload_shed_off") \
+        < types.index("serve_slo_recovered")
+    downs = [f for e, f in journal if e == "serve_autoscale"
+             and f.get("direction") == "down"]
+    assert downs and downs[0]["reason"] == "slo_headroom"
+
+
+# -------------------------------------------------------- cluster fixture
+
+
+@pytest.fixture(scope="module")
+def slo_rt():
+    rt.init(num_cpus=6, _system_config={
+        "object_store_memory_bytes": 128 * 1024 * 1024,
+        "worker_pool_prestart": 2,
+        "metrics_export_period_s": 0.25,
+    })
+    yield rt
+    serve.shutdown()
+    rt.shutdown()
+
+
+# NOTE: deployment classes below define their record-synthesis helper as
+# a method and import only inside method bodies — replica workers cannot
+# resolve this test module's globals when unpickling the callable.
+
+
+def _journal_events(etype="", deployment=""):
+    from ray_tpu.core.worker import global_worker
+    evs = global_worker.backend.head.call(
+        "events_dump", {"type": etype} if etype else {}, timeout=10)
+    if deployment:
+        evs = [e for e in evs if e.get("deployment") == deployment]
+    return evs
+
+
+def test_scale_down_drain_completes_inflight(slo_rt):
+    """Graceful scale-down: victims leave the routing table immediately
+    but finish their in-flight requests before the replica is released."""
+    @serve.deployment(name="drainer", num_replicas=2,
+                      max_ongoing_requests=4)
+    class Slow:
+        def __call__(self, i):
+            time.sleep(1.5)
+            return i
+
+    h = serve.run(Slow.bind())
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline \
+            and serve.status()["drainer"]["ready_replicas"] < 2:
+        time.sleep(0.2)
+    assert serve.status()["drainer"]["ready_replicas"] == 2
+
+    # load both replicas, then scale down mid-flight
+    resps = [h.remote(i) for i in range(4)]
+    time.sleep(0.3)  # let the requests land replica-side
+    serve.run(Slow.options(num_replicas=1).bind())
+    out = sorted(r.result(timeout=60) for r in resps)
+    assert out == [0, 1, 2, 3], "drain dropped in-flight requests"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        info = serve.status()["drainer"]
+        if info["live_replicas"] == 1 and info["draining"] == 0:
+            break
+        time.sleep(0.2)
+    info = serve.status()["drainer"]
+    assert info["live_replicas"] == 1 and info["draining"] == 0, info
+    serve.delete("drainer")
+
+
+def test_overload_ladder_sheds_and_recovers(slo_rt):
+    """End to end at max replicas: sustained SLO breach climbs the
+    ladder (replicas receive set_overload_level pushes), sheds new
+    requests to the cheaper multiplexed model, and unwinds once the
+    breach clears — every step replayable from the event journal."""
+    @serve.deployment(name="degrader", num_replicas=1,
+                      max_ongoing_requests=8,
+                      autoscaling_config={
+                          "policy": "slo", "min_replicas": 1,
+                          "max_replicas": 1, "slo_eval_period_s": 0.25,
+                          "slo_window_s": 1.5, "target_attainment": 0.9,
+                          "overload_steps": 2, "overload_max_level": 2,
+                          "overload_budget_factor": 0.5,
+                          "shed_model_id": "tiny-model"})
+    class Degrader:
+        def __init__(self):
+            from ray_tpu.llm.request_log import FlightRecorder
+            self.recorder = FlightRecorder(capacity=512,
+                                           observe_metrics=False)
+            self.levels = []
+
+        def set_overload_level(self, level, budget_factor=0.5):
+            self.levels.append((level, budget_factor))
+            return level
+
+        def seen_levels(self):
+            return list(self.levels)
+
+        def _record(self, ttft_s, tpot_s=0.002):
+            import uuid as _uuid
+            rec = self.recorder.start(_uuid.uuid4().hex, 8, 16)
+            rec.note_admit(rec.t0, 0)
+            rec.note_first(rec.t0 + ttft_s)
+            rec.note_decode(rec.t0 + ttft_s + tpot_s, 1)
+            rec.note_decode(rec.t0 + ttft_s + 2 * tpot_s, 1)
+            self.recorder.finish(rec, rec.t0 + ttft_s + 3 * tpot_s,
+                                 "stop")
+
+        def __call__(self, ttft_s):
+            from ray_tpu.serve import get_multiplexed_model_id
+            self._record(ttft_s)
+            return get_multiplexed_model_id()
+
+    h = serve.run(Degrader.bind())
+    h.remote(0.01).result(timeout=60)   # warm up
+
+    # storm: every request records a hard TTFT breach; hold until the
+    # ladder tops out and sheds
+    deadline = time.monotonic() + 45
+    shed_seen = ""
+    while time.monotonic() < deadline:
+        shed_seen = h.remote(0.7).result(timeout=30)
+        info = serve.status()["degrader"]
+        if info["shed_to"] == "tiny-model" and shed_seen == "tiny-model":
+            break
+        time.sleep(0.1)
+    info = serve.status()["degrader"]
+    assert info["shed_to"] == "tiny-model", info
+    assert info["overload_level"] == 2, info
+    assert shed_seen == "tiny-model", \
+        "router never re-routed to the shed model"
+    levels = h.seen_levels.remote().result(timeout=30)
+    assert [lv for lv, _ in levels][:2] == [1, 2], levels
+    # the handle's router counted its shed decisions
+    from ray_tpu.util import metrics as metrics_mod
+    shed_total = sum(metrics_mod.snapshot().get(
+        "serve_overload_shed_total", {}).get("values", {}).values())
+    assert shed_total >= 1
+
+    # calm: breach records age out of the window -> full unwind
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        info = serve.status()["degrader"]
+        if info["shed_to"] == "" and info["overload_level"] == 0:
+            break
+        time.sleep(0.25)
+    info = serve.status()["degrader"]
+    assert info["shed_to"] == "" and info["overload_level"] == 0, info
+
+    types = [e["type"] for e in _journal_events()
+             if e.get("deployment") == "degrader"]
+    for expected in ("serve_slo_breach", "serve_overload_level",
+                     "serve_overload_shed_on", "serve_overload_shed_off",
+                     "serve_slo_recovered"):
+        assert expected in types, (expected, types)
+    assert types.index("serve_overload_shed_on") \
+        < types.index("serve_overload_shed_off") \
+        < types.index("serve_slo_recovered")
+    serve.delete("degrader")
+
+
+@pytest.mark.slow
+def test_diurnal_load_slo_recovery_from_journal(slo_rt):
+    """The diurnal soak: a synthetic load wave overloads the service,
+    the SLO loop scales out until attainment recovers, and the calm
+    phase packs back down — all asserted against the event journal."""
+    OFFERED_STORM, OFFERED_CALM, CAP = 18, 2, 6
+
+    @serve.deployment(name="diurnal", num_replicas=1,
+                      max_ongoing_requests=32,
+                      autoscaling_config={
+                          "policy": "slo", "min_replicas": 1,
+                          "max_replicas": 3, "slo_eval_period_s": 0.3,
+                          "slo_window_s": 2.0, "target_attainment": 0.9,
+                          "overload_steps": 10_000,
+                          "scale_down_evals": 6})
+    class Synthetic:
+        def __init__(self):
+            from ray_tpu.llm.request_log import FlightRecorder
+            self.recorder = FlightRecorder(capacity=1024,
+                                           observe_metrics=False)
+
+        def _record(self, ttft_s, tpot_s=0.002):
+            import uuid as _uuid
+            rec = self.recorder.start(_uuid.uuid4().hex, 8, 16)
+            rec.note_admit(rec.t0, 0)
+            rec.note_first(rec.t0 + ttft_s)
+            rec.note_decode(rec.t0 + ttft_s + tpot_s, 1)
+            rec.note_decode(rec.t0 + ttft_s + 2 * tpot_s, 1)
+            self.recorder.finish(rec, rec.t0 + ttft_s + 3 * tpot_s,
+                                 "stop")
+
+        def __call__(self, ttft_s):
+            import time as _time
+            _time.sleep(0.2)
+            self._record(ttft_s)
+            return ttft_s
+
+    h = serve.run(Synthetic.bind())
+
+    def round_trip(offered):
+        # per-replica load decides latency: the diurnal model of a
+        # fixed-capacity replica (CAP concurrent before TTFT collapses)
+        n_live = max(1, serve.status()["diurnal"]["live_replicas"])
+        ttft = 0.02 if offered / n_live <= CAP else 0.7
+        resps = [h.remote(ttft) for _ in range(offered)]
+        for r in resps:
+            r.result(timeout=60)
+
+    for _ in range(6):                      # morning calm
+        round_trip(OFFERED_CALM)
+    assert not _journal_events("serve_slo_breach", "diurnal"), \
+        "calm traffic must not breach"
+
+    storm_t0 = time.time()
+    for _ in range(40):                     # midday storm
+        round_trip(OFFERED_STORM)
+
+    breaches = [e for e in _journal_events("serve_slo_breach",
+                                           "diurnal")
+                if e["ts"] >= storm_t0]
+    assert breaches, "storm never registered as an SLO breach"
+    ups = [e for e in _journal_events("serve_autoscale", "diurnal")
+           if e.get("direction") == "up" and e["ts"] >= storm_t0]
+    assert ups and ups[-1]["to"] == 3, \
+        f"SLO loop never scaled to max: {ups}"
+    assert all(e.get("reason") == "slo_attainment" for e in ups)
+    # recovery: once capacity matched load, breaches STOPPED — within a
+    # few controller evals of the last scale-up (window 2s + eval 0.3s)
+    recover_by = ups[-1]["ts"] + 4.0
+    late = [e for e in _journal_events("serve_slo_breach", "diurnal")
+            if e["ts"] > recover_by]
+    assert not late, \
+        f"attainment never recovered after scale-up: {late[-3:]}"
+
+    for _ in range(40):                     # evening calm: pack down
+        round_trip(OFFERED_CALM)
+        if serve.status()["diurnal"]["live_replicas"] == 1:
+            break
+    downs = [e for e in _journal_events("serve_autoscale", "diurnal")
+             if e.get("direction") == "down"]
+    assert downs and all(e["reason"] == "slo_headroom" for e in downs)
+    assert serve.status()["diurnal"]["live_replicas"] == 1
+    serve.delete("diurnal")
