@@ -43,14 +43,30 @@ def spill_file_path(session_dir: str, shm_name: str, oid_hex: str) -> str:
     return os.path.join(spill_dir_for(session_dir, shm_name), oid_hex)
 
 
+_spill_fs = None
+
+
+def spill_filesystem():
+    """Process-wide storage seam for spill I/O (lazy: daemons import this
+    module before metrics are configured). All spill reads/writes ride the
+    fault-injectable, retrying filesystem so ``storage.*`` chaos points
+    and ``storage_*`` metrics cover the spill path too."""
+    global _spill_fs
+    if _spill_fs is None:
+        from ray_tpu.util.filesystem import storage_filesystem
+        _spill_fs = storage_filesystem(None)
+    return _spill_fs
+
+
 def read_spill_file(session_dir: str, shm_name: str,
                     oid_hex: str) -> Optional[bytes]:
     try:
-        with open(spill_file_path(session_dir, shm_name, oid_hex),
-                  "rb") as f:
-            return f.read()
-    except OSError:
+        return spill_filesystem().get(
+            spill_file_path(session_dir, shm_name, oid_hex))
+    except FileNotFoundError:
         return None
+    except Exception:  # noqa: BLE001 — a lost/corrupt spill file reads as
+        return None    # absent; callers fall back to lineage reconstruction
 
 
 class ObjectPlane:
@@ -187,13 +203,9 @@ class ObjectPlane:
         return spill_dir_for(GlobalConfig.session_dir, self.store.name)
 
     def _write_spill(self, object_id: ObjectID, data: bytes) -> None:
-        d = self._spill_dir()
-        os.makedirs(d, exist_ok=True)
-        path = os.path.join(d, object_id.hex())
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        # atomic publish + transient-error retry via the storage seam
+        spill_filesystem().put(
+            os.path.join(self._spill_dir(), object_id.hex()), data)
         if self._acct:
             self._m_spill_write_total.inc()
             self._m_spill_write_bytes.inc(len(data))

@@ -10,7 +10,8 @@ pjit program (FSDP/TP/PP/SP via ray_tpu.parallel).
 
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import (Checkpoint, CheckpointCorrupt,
+                                      CheckpointManager)
 from ray_tpu.train.result import Result
 from ray_tpu.train.session import (TrainContext, get_context, report,
                                    get_checkpoint, get_dataset_shard)
@@ -21,7 +22,8 @@ from ray_tpu.train.trainer import JaxTrainer
 
 __all__ = [
     "JaxTrainer", "RunConfig", "ScalingConfig", "FailureConfig",
-    "CheckpointConfig", "Checkpoint", "Result", "TrainContext",
+    "CheckpointConfig", "Checkpoint", "CheckpointCorrupt",
+    "CheckpointManager", "Result", "TrainContext",
     "get_context", "get_checkpoint", "get_dataset_shard", "report",
     "make_train_step", "shard_params",
     "profile_train_step", "StepBreakdown", "PHASES",

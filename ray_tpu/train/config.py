@@ -84,6 +84,16 @@ class CheckpointConfig:
 
     num_to_keep: Optional[int] = None
     checkpoint_frequency: int = 0  # steps between auto-checkpoints (0 = off)
+    #: Overlap saves with compute (TorchTitan-style async distributed
+    #: checkpointing): report() only pays the device→host copy, while
+    #: serialization + upload + commit run on a background writer (one
+    #: save in flight; a second blocks until the slot frees). Off by
+    #: default: sync saves return with the manifest committed, which
+    #: deterministic tests and scripts rely on.
+    async_save: bool = False
+    #: how long rank 0 waits for every host's shard sidecar before
+    #: declaring the save abandoned (checkpoint_abandoned journal record)
+    barrier_timeout_s: float = 60.0
 
 
 @dataclasses.dataclass

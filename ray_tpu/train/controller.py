@@ -8,13 +8,14 @@ RETRY vs RAISE and restarts resuming from the latest checkpoint.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import logging
 import threading
 import time
 from typing import Callable, List, Optional
 
-from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.checkpoint import CheckpointManager, _journal
 from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
 from ray_tpu.train.result import Result
 from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
@@ -145,14 +146,26 @@ class TrainController:
             self._start_grow_monitor(group, size, upscale, stop_mon)
             try:
                 self.state = ControllerState.RUNNING
+                # No group is running here, so the run root has no live
+                # writers: GC debris a crashed save left behind (emits
+                # checkpoint_abandoned), THEN pick the restore point.
+                # latest() only ever surfaces COMMITTED checkpoints — an
+                # elastic restart lands on the last manifest, never on a
+                # half-written dir, and load(shardings=) re-shards the
+                # state onto the resized mesh inside the worker loop
+                self.ckpt_manager._gc_debris()
                 restore = self.ckpt_manager.latest()
                 logger.info("running %d workers (restore=%s)", size,
                             restore.path if restore else None)
+                if restore is not None:
+                    _journal("train_restore", path=restore.path,
+                             step=CheckpointManager.step_of(restore.path),
+                             world_size=size,
+                             restart=self.failure_policy.failures)
                 per_worker = group.run(
                     self.train_fn, self.storage_path,
                     self.train_loop_config, restore,
-                    self.run_config.checkpoint_config.num_to_keep,
-                    self.run_config.checkpoint_config.checkpoint_frequency,
+                    dataclasses.asdict(self.run_config.checkpoint_config),
                     self.datasets)
                 history.extend(per_worker[0])
                 self.state = ControllerState.FINISHED

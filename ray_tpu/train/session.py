@@ -37,6 +37,9 @@ class TrainContext:
         self.reported: List[Dict[str, Any]] = []
         self.step = 0
         self._last_report_t: Optional[float] = None
+        # step-hiccup telemetry: steady-state step time (EMA over steps
+        # with no save in flight) vs the worst step seen during a save
+        self._steady_step_ema: Optional[float] = None
 
     # -- API used inside train_loop_per_worker ------------------------------
     def get_world_size(self) -> int:
@@ -47,11 +50,17 @@ class TrainContext:
 
     def report(self, metrics: Dict[str, Any],
                checkpoint_tree: Any = None) -> None:
-        """Record metrics; optionally snapshot a pytree checkpoint (rank 0).
+        """Record metrics; optionally snapshot a pytree checkpoint.
 
         With CheckpointConfig.checkpoint_frequency=N, only every Nth report
         persists the offered tree (reference: air CheckpointConfig — the
         trainer thins framework-offered checkpoints, not user metrics).
+
+        Saves are SHARDED: every rank persists only its addressable shards
+        (no gather collective, no full tree on any host), so all ranks must
+        offer the checkpoint_tree on the same steps. With
+        CheckpointConfig.async_save the call only pays the device→host
+        copy; otherwise rank 0 returns with the manifest committed.
         """
         self.step += 1
         entry = dict(metrics)
@@ -59,16 +68,16 @@ class TrainContext:
         if self.checkpoint_frequency > 0 \
                 and self.step % self.checkpoint_frequency != 0:
             checkpoint_tree = None
-        if checkpoint_tree is not None:
-            # gather-before-save is a COLLECTIVE when the tree spans
-            # processes (multi-host mesh): every rank participates here,
-            # then only rank 0 touches storage
-            from ray_tpu.train.checkpoint import gather_to_host
-            checkpoint_tree = gather_to_host(checkpoint_tree)
-        if checkpoint_tree is not None and self.rank == 0 \
-                and self.ckpt_manager is not None:
-            ckpt = self.ckpt_manager.save(checkpoint_tree, self.step, metrics)
-            entry["_checkpoint_path"] = ckpt.path
+        if checkpoint_tree is not None and self.ckpt_manager is not None:
+            if self.ckpt_manager.async_save:
+                self.ckpt_manager.save_async(
+                    checkpoint_tree, self.step,
+                    metrics if self.rank == 0 else None)
+            else:
+                self.ckpt_manager.save(
+                    checkpoint_tree, self.step,
+                    metrics if self.rank == 0 else None)
+            entry["_checkpoint_path"] = self.ckpt_manager.dir_for(self.step)
         self.reported.append(entry)
         if self.rank == 0:
             self._emit_step_gauges(metrics)
@@ -90,6 +99,17 @@ class TrainContext:
             from ray_tpu.util import metrics as metrics_mod
             metrics_mod.train_step_time_gauge().set(dt)
             metrics_mod.train_throughput_gauge().set(1.0 / dt)
+            # step hiccup: how much worse a step got while an async save
+            # was in flight, vs the steady-state (no-save) EMA
+            saving = self.ckpt_manager is not None \
+                and self.ckpt_manager.in_flight()
+            if saving and self._steady_step_ema:
+                metrics_mod.train_checkpoint_step_hiccup_seconds_gauge() \
+                    .set(max(0.0, dt - self._steady_step_ema))
+            elif not saving:
+                ema = self._steady_step_ema
+                self._steady_step_ema = dt if ema is None \
+                    else 0.8 * ema + 0.2 * dt
             flops = metrics.get("flops_per_step")
             peak = metrics.get("peak_flops") \
                 or float(os.environ.get("RTPU_PEAK_FLOPS", 0) or 0)

@@ -32,25 +32,35 @@ class _TrainWorker:
     def run(self, fn: Callable, storage_path: str,
             train_loop_config: Optional[dict],
             restore_path: Optional[str],
-            num_to_keep: Optional[int],
-            checkpoint_frequency: int = 0,
+            ckpt_cfg: Optional[dict] = None,
             dataset_shards: Optional[dict] = None,
             jax_dist: Optional[dict] = None,
-            mesh_spec=None) -> List[dict]:
+            mesh_spec=None,
+            restore_fallbacks: tuple = ()) -> List[dict]:
         if jax_dist is not None:
             # multi-host bootstrap BEFORE the user loop: after this,
             # jax.devices() is the global set (reference analog:
             # train/torch/config.py:66 process-group setup)
             from ray_tpu.train.backend import setup_jax_worker
             setup_jax_worker({**jax_dist, "process_id": self.rank})
+        cc = ckpt_cfg or {}
+        # every rank gets a manager over the same root: saves are sharded
+        # (each host uploads shard-<rank>.npz; rank 0 commits the manifest)
+        manager = CheckpointManager(
+            storage_path,
+            num_to_keep=cc.get("num_to_keep"),
+            rank=self.rank, world_size=self.world_size,
+            async_save=bool(cc.get("async_save", False)),
+            barrier_timeout_s=float(cc.get("barrier_timeout_s", 60.0)))
         ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
             storage_path=storage_path,
-            ckpt_manager=CheckpointManager(
-                storage_path, num_to_keep=num_to_keep),
-            restore_from=(Checkpoint(restore_path) if restore_path else None),
+            ckpt_manager=manager,
+            restore_from=(Checkpoint(restore_path,
+                                     fallbacks=tuple(restore_fallbacks))
+                          if restore_path else None),
             train_loop_config=train_loop_config,
-            checkpoint_frequency=checkpoint_frequency,
+            checkpoint_frequency=int(cc.get("checkpoint_frequency", 0)),
             dataset_shards=dataset_shards,
             mesh_spec=mesh_spec)
         if restore_path:
@@ -60,9 +70,14 @@ class _TrainWorker:
         _set_context(ctx)
         try:
             fn(dict(ctx.train_loop_config)) if _wants_arg(fn) else fn()
+            # drain the async writer before declaring the loop done —
+            # a save still in flight must commit (or surface its error)
+            # before the controller reads latest()
+            manager.flush()
             return ctx.reported
         finally:
             _set_context(None)
+            manager.flush(raise_errors=False)
 
     @ray_tpu.method(concurrency_group="control")
     def health_check(self) -> bool:
@@ -118,8 +133,7 @@ class WorkerGroup:
     def run(self, fn: Callable, storage_path: str,
             train_loop_config: Optional[dict],
             restore: Optional[Checkpoint],
-            num_to_keep: Optional[int],
-            checkpoint_frequency: int = 0,
+            ckpt_cfg: Optional[dict] = None,
             datasets: Optional[dict] = None) -> List[List[dict]]:
         """Execute the loop on every worker; raise WorkerGroupError on the
         first failure (reference: backend_executor re-raises worker errors)."""
@@ -149,9 +163,9 @@ class WorkerGroup:
         mesh_spec = getattr(self.scaling, "mesh", None) \
             if self.scaling is not None else None
         refs = [w.run.remote(fn, storage_path, train_loop_config,
-                             restore.path if restore else None, num_to_keep,
-                             checkpoint_frequency, shards_by_rank[rank],
-                             jax_dist, mesh_spec)
+                             restore.path if restore else None, ckpt_cfg,
+                             shards_by_rank[rank], jax_dist, mesh_spec,
+                             tuple(restore.fallbacks) if restore else ())
                 for rank, w in enumerate(self.workers)]
         # Await completions in ARRIVAL order, not rank order: a crash on
         # rank>0 must surface even while rank 0 blocks in a collective

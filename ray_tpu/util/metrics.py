@@ -269,6 +269,68 @@ def train_phase_time_gauge() -> Gauge:
                  tag_keys=("phase",))
 
 
+def train_checkpoint_write_seconds_histogram() -> Histogram:
+    """Wall seconds of one host's checkpoint shard write (serialize +
+    upload, measured on the background writer thread — the time the
+    TRAINING thread does NOT pay when async saves overlap compute)."""
+    return Histogram(
+        "train_checkpoint_write_seconds",
+        description="seconds to serialize and upload one host's "
+                    "checkpoint shard (background writer)")
+
+
+def train_checkpoint_write_bytes_counter() -> Counter:
+    """Bytes of checkpoint shard data this host uploaded. Per-host by
+    construction — comparing it against the full tree size is the proof
+    that no single host serialized everything."""
+    return Counter(
+        "train_checkpoint_write_bytes",
+        description="checkpoint shard bytes written by this host")
+
+
+def train_checkpoint_queue_depth_count() -> Gauge:
+    """In-flight async checkpoint saves queued behind the writer thread
+    (bounded at 1: a save arriving while one is in flight blocks the
+    training thread until the slot frees)."""
+    return Gauge(
+        "train_checkpoint_queue_depth_count",
+        description="async checkpoint saves in flight (bounded queue)")
+
+
+def train_checkpoint_step_hiccup_seconds_gauge() -> Gauge:
+    """Max step time observed while an async save was in flight MINUS
+    the median steady-state step time — the direct 'does checkpointing
+    hiccup training' number (TorchTitan's flat-step-time criterion)."""
+    return Gauge(
+        "train_checkpoint_step_hiccup_seconds",
+        description="max in-flight-save step time minus steady-state "
+                    "median (rank 0)")
+
+
+def storage_retry_total_counter() -> Counter:
+    """Transient-error retries inside the storage seam, tagged by op —
+    a rising rate is the early-warning for a degrading store."""
+    return Counter("storage_retry_total",
+                   description="storage-seam transient-error retries",
+                   tag_keys=("op",))
+
+
+def storage_op_seconds_histogram() -> Histogram:
+    """End-to-end storage-seam op latency (including retries/backoff),
+    tagged by op."""
+    return Histogram("storage_op_seconds",
+                     description="storage filesystem op seconds "
+                                 "(including retries)",
+                     tag_keys=("op",))
+
+
+def storage_put_bytes_counter() -> Counter:
+    """Bytes published through the storage seam (checkpoint shards,
+    workflow state, spill files)."""
+    return Counter("storage_put_bytes",
+                   description="bytes written through the storage seam")
+
+
 def llm_kv_page_utilization_gauge() -> Gauge:
     """Fraction of the paged KV pool's allocatable pages (all but the
     scratch page) currently held by sequences or the prefix cache."""

@@ -75,41 +75,39 @@ class WorkflowStorage:
 
 
 class FilesystemStorage(WorkflowStorage):
-    """Default backend: one directory per workflow, atomic file writes."""
+    """Default backend: one directory per workflow, routed through the
+    shared :mod:`ray_tpu.util.filesystem` seam (atomic puts, transient-
+    error retries, ``storage.*`` fault points — the same durability
+    contract train checkpoints and spill use). ``fs`` accepts any
+    StorageFilesystem or a ``memory://name`` spec for tests."""
 
-    def __init__(self, root: str = _DEFAULT_STORAGE):
+    def __init__(self, root: str = _DEFAULT_STORAGE, fs=None):
+        from ray_tpu.util.filesystem import storage_filesystem
         self.root = root
+        self.fs = storage_filesystem(fs)
 
     def _path(self, key: str) -> str:
         wf, _, name = key.partition("/")
         return os.path.join(self.root, wf, name)
 
     def put(self, key: str, data: bytes) -> None:
-        path = self._path(key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        self.fs.put(self._path(key), data)
 
     def get(self, key: str) -> Optional[bytes]:
         try:
-            with open(self._path(key), "rb") as f:
-                return f.read()
+            return self.fs.get(self._path(key))
         except FileNotFoundError:
             return None
 
     def list_ids(self) -> List[str]:
-        try:
-            return sorted(d for d in os.listdir(self.root)
-                          if os.path.isdir(os.path.join(self.root, d)))
-        except FileNotFoundError:
-            return []
+        # a workflow exists iff its directory has at least one object
+        # (object stores have no empty directories)
+        return sorted(
+            d for d in self.fs.list(self.root)
+            if self.fs.list(os.path.join(self.root, d)))
 
     def delete_workflow(self, workflow_id: str) -> None:
-        import shutil
-        shutil.rmtree(os.path.join(self.root, workflow_id),
-                      ignore_errors=True)
+        self.fs.delete(os.path.join(self.root, workflow_id))
 
 
 class KVStorage(WorkflowStorage):

@@ -1,0 +1,153 @@
+"""StorageFilesystem seam: local/memory backends, retry policy, fault
+points, and the resolver (ISSUE 14 tentpole part 1)."""
+
+import os
+import threading
+
+import pytest
+
+from ray_tpu.util.filesystem import (FaultInjectableFilesystem,
+                                     LocalFilesystem, MemoryFilesystem,
+                                     RetryPolicy, StorageError,
+                                     storage_filesystem)
+
+
+class TestLocalFilesystem:
+    def test_put_get_roundtrip_and_overwrite(self, tmp_path):
+        fs = LocalFilesystem()
+        p = str(tmp_path / "a" / "b.bin")
+        fs.put(p, b"one")
+        assert fs.get(p) == b"one"
+        fs.put(p, b"two")
+        assert fs.get(p) == b"two"
+
+    def test_put_is_atomic_no_staging_left(self, tmp_path):
+        fs = LocalFilesystem()
+        fs.put(str(tmp_path / "x"), b"data")
+        assert sorted(os.listdir(tmp_path)) == ["x"]  # no .tmp.* debris
+
+    def test_get_missing_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            LocalFilesystem().get(str(tmp_path / "nope"))
+
+    def test_list_delete_rename(self, tmp_path):
+        fs = LocalFilesystem()
+        fs.put(str(tmp_path / "d" / "one"), b"1")
+        fs.put(str(tmp_path / "d" / "two"), b"2")
+        assert fs.list(str(tmp_path / "d")) == ["one", "two"]
+        assert fs.list(str(tmp_path / "missing")) == []
+        fs.rename(str(tmp_path / "d" / "one"), str(tmp_path / "d" / "uno"))
+        assert fs.list(str(tmp_path / "d")) == ["two", "uno"]
+        fs.delete(str(tmp_path / "d"))  # whole-tree delete
+        assert fs.list(str(tmp_path / "d")) == []
+        fs.delete(str(tmp_path / "d"))  # absent path is a no-op
+
+
+class TestMemoryFilesystem:
+    def test_roundtrip_list_exists(self):
+        fs = MemoryFilesystem()
+        fs.put("/run/ck/one", b"1")
+        fs.put("/run/ck/sub/two", b"2")
+        assert fs.get("run/ck/one") == b"1"
+        assert fs.list("/run/ck") == ["one", "sub"]
+        assert fs.exists("/run/ck/sub")  # "directory" prefix exists
+        with pytest.raises(FileNotFoundError):
+            fs.get("/run/ck/three")
+
+    def test_delete_tree_and_rename(self):
+        fs = MemoryFilesystem()
+        fs.put("a/x", b"1")
+        fs.put("a/y/z", b"2")
+        fs.rename("a", "b")
+        assert fs.get("b/x") == b"1" and fs.get("b/y/z") == b"2"
+        fs.delete("b")
+        assert fs.list("b") == []
+        with pytest.raises(FileNotFoundError):
+            fs.rename("gone", "anywhere")
+
+    def test_put_copies_bytes(self):
+        fs = MemoryFilesystem()
+        buf = bytearray(b"abc")
+        fs.put("k", buf)
+        buf[0] = ord("z")
+        assert fs.get("k") == b"abc"
+
+
+class TestRetryPolicy:
+    def test_backoff_is_bounded_full_jitter(self):
+        rp = RetryPolicy(max_attempts=5, base_s=0.1, cap_s=0.3)
+        for attempt in range(1, 10):
+            for _ in range(20):
+                s = rp.backoff_s(attempt)
+                assert 0.0 <= s <= min(0.3, 0.1 * 2 ** attempt)
+
+
+class TestFaultInjectableFilesystem:
+    def test_transient_faults_are_retried(self, fault_injector):
+        fs = FaultInjectableFilesystem(
+            MemoryFilesystem(), retry=RetryPolicy(max_attempts=4,
+                                                  base_s=0.001, cap_s=0.002))
+        fault_injector.configure("storage.put=raise*2")  # fail, fail, ok
+        fs.put("k", b"v")
+        assert fs.get("k") == b"v"
+
+    def test_exhausted_retries_raise_storage_error(self, fault_injector):
+        fs = FaultInjectableFilesystem(
+            MemoryFilesystem(), retry=RetryPolicy(max_attempts=3,
+                                                  base_s=0.001, cap_s=0.002))
+        fault_injector.configure("storage.put=raise")  # unlimited
+        with pytest.raises(StorageError):
+            fs.put("k", b"v")
+
+    def test_absence_is_not_retried(self, fault_injector):
+        # FileNotFoundError must pass straight through — retrying a
+        # missing object would turn every latest()-probe into a stall
+        fs = FaultInjectableFilesystem(MemoryFilesystem())
+        with pytest.raises(FileNotFoundError):
+            fs.get("never-put")
+
+    def test_get_point_covers_reads(self, fault_injector):
+        fs = FaultInjectableFilesystem(
+            MemoryFilesystem(), retry=RetryPolicy(max_attempts=2,
+                                                  base_s=0.001, cap_s=0.002))
+        fs.put("k", b"v")
+        fault_injector.configure("storage.get=raise")
+        with pytest.raises(StorageError):
+            fs.get("k")
+
+
+class TestResolver:
+    def test_default_is_fault_injectable_local(self):
+        fs = storage_filesystem(None)
+        assert isinstance(fs, FaultInjectableFilesystem)
+        assert isinstance(fs.inner, LocalFilesystem)
+
+    def test_memory_spec_is_process_shared(self):
+        a = storage_filesystem("memory://shared-x")
+        b = storage_filesystem("memory://shared-x")
+        a.put("k", b"v")
+        assert b.get("k") == b"v"  # same named store
+        other = storage_filesystem("memory://other")
+        with pytest.raises(FileNotFoundError):
+            other.get("k")
+
+    def test_instance_passthrough(self):
+        mem = MemoryFilesystem()
+        assert storage_filesystem(mem) is mem
+
+    def test_concurrent_memory_puts(self):
+        fs = storage_filesystem("memory://concurrent")
+        errs = []
+
+        def work(i):
+            try:
+                for j in range(50):
+                    fs.put(f"d/{i}-{j}", bytes([i]))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+        assert len(fs.list("d")) == 400

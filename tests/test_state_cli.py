@@ -375,6 +375,35 @@ def test_object_store_metric_names_follow_convention():
     assert len(names) == len(factories)  # no duplicate registrations
 
 
+def test_checkpoint_and_storage_metric_names_follow_convention():
+    """Same lint for the ISSUE 14 series: train_checkpoint_* (async save
+    telemetry) and storage_* (filesystem-seam retries/latency/volume)
+    must follow <subsystem>_<noun>_<unit> with a sanctioned unit suffix."""
+    import re
+
+    from ray_tpu.util import metrics as m
+
+    factories = [
+        m.train_checkpoint_write_seconds_histogram,
+        m.train_checkpoint_write_bytes_counter,
+        m.train_checkpoint_queue_depth_count,
+        m.train_checkpoint_step_hiccup_seconds_gauge,
+        m.storage_retry_total_counter,
+        m.storage_op_seconds_histogram,
+        m.storage_put_bytes_counter,
+    ]
+    pat = re.compile(
+        r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)*_(bytes|seconds|total|count)$")
+    names = set()
+    for f in factories:
+        inst = f()
+        assert pat.match(inst.name), inst.name
+        assert inst.name.startswith(("train_checkpoint_", "storage_")), \
+            inst.name
+        names.add(inst.name)
+    assert len(names) == len(factories)
+
+
 def test_task_event_buffer_ring_eviction():
     """Satellite: the span buffer is a ring — at MAX_BUFFER the OLDEST
     spans are evicted (not the newest refused) and the __dropped__
