@@ -65,6 +65,33 @@ def timed(fn, n_threads: int, seconds: float = 2.0) -> float:
     return sum(counts) / dt
 
 
+def profiled(label: str, out: list, fn, n_threads: int) -> float:
+    """timed() with a concurrent in-process burst capture: Head() lives
+    in THIS process, so the burst's hot frames ARE the head policy's —
+    the frame-level evidence behind the measured ceiling (stack_profiler
+    burst mode; same data 'profile --record' returns cluster-wide)."""
+    from ray_tpu.util.stack_profiler import burst_capture, top_frames
+    cap: dict = {}
+
+    def _capture():
+        cap["export"] = burst_capture(1.5, hz=199.0)
+
+    th = threading.Thread(target=_capture, name=f"profile-{label}")
+    th.start()
+    rate = timed(fn, n_threads)
+    th.join(timeout=10.0)
+    e = cap.get("export") or {}
+    samples = int(e.get("samples") or 0)
+    out.append({"metric": f"head_profile_{label}",
+                "samples": samples,
+                "top_frames": [
+                    {"frame": r["frame"], "self": r["self"],
+                     "self_pct": round(100.0 * r["self"] / max(1, samples),
+                                       1)}
+                    for r in top_frames(e.get("stacks") or {}, 5)]})
+    return rate
+
+
 def main() -> None:
     head = Head()
     addr = head.address
@@ -84,7 +111,7 @@ def main() -> None:
         clients[t].call("kv_put", {"key": f"b:{t}:{i % 64}",
                                    "value": b"x" * 256})
         clients[t].call("kv_get", {"key": f"b:{t}:{i % 64}"})
-    rate = timed(kv_cycle, T)
+    rate = profiled("kv_cycle", out, kv_cycle, T)
     out.append({"metric": "head_kv_write_read_cycles_per_s",
                 "value": round(rate, 1),
                 "note": "256B values; one cycle = put + get (pickle RPC "
@@ -126,7 +153,7 @@ def main() -> None:
             "resources": {"CPU": 1.0}, "requester": f"bench-{t}"})
         if r and r.get("lease_id"):
             clients[t].call("release_lease", {"lease_id": r["lease_id"]})
-    rate = timed(lease_cycle, T)
+    rate = profiled("lease_cycle", out, lease_cycle, T)
     out.append({"metric": "head_lease_cycles_per_s",
                 "value": round(rate, 1),
                 "note": f"grant+release cycles over a {M}-node table "

@@ -67,6 +67,7 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "max_lineage_bytes": (int, 64 * 1024**2, "lineage cache cap per owner"),
     # --- train / ml ---
     "train_health_poll_s": (float, 2.0, "train controller worker poll"),
+    "train_straggler_factor": (float, 2.0, "cross-host straggler attribution: rank 0 compares per-host train phase times each step, and a host slower than the fastest host by more than this factor raises train_phase_skew_s{phase,host} plus a train_straggler journal event naming the lagging host; 0 disables the comparison"),
     # --- llm serving ---
     "llm_prefix_cache": (bool, True, "share page-aligned prompt-prefix KV pages across requests (vLLM-style automatic prefix caching; LRU-evicted under allocator pressure)"),
     "llm_prefill_chunk": (int, 512, "prompts (or uncached tails) longer than this prefill in chunks interleaved with decode steps, so one long prompt never stalls the running batch for a full prefill dispatch"),
@@ -95,6 +96,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "event_buffer_size": (int, 10000, "task event buffer cap"),
     "metrics_export_period_s": (float, 5.0, "metrics push period"),
     "hw_sampler_period_s": (float, 2.0, "node hardware sampler period (cpu/rss/cgroup/arena/tpu); 0 disables"),
+    "profile_enabled": (bool, True, "continuous wall-clock stack sampler (util/stack_profiler.py) in every process — head, node daemons, workers, drivers; collapsed-stack profiles ride telemetry_push into the head's ProfileStore ('python -m ray_tpu profile'); disable to A/B the sampling overhead (BENCH_profile.json records it at <2%)"),
+    "profile_hz": (float, 19.0, "continuous profiler sampling rate (Hz); the prime-ish default never phase-locks with the 1-2s periodic loops it observes, so those loops sample in proportion to the time they actually burn; burst captures ('profile --record S --hz N') pick their own rate"),
+    "profile_table_size": (int, 512, "distinct collapsed stacks held per process between telemetry flushes; samples landing on new stacks once the table is full are dropped and counted exactly (the profile keeps an honest denominator: profile_dropped_samples_total)"),
     "timeseries_ring_points": (int, 512, "points kept per (node, metric) hardware time series at the head"),
     "cluster_event_journal_size": (int, 4096, "structured cluster events (node/worker/actor/spill/lease/autoscaler transitions) kept in the head's journal ring ('python -m ray_tpu events'); oldest evict first"),
 }

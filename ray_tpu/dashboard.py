@@ -35,6 +35,11 @@ Endpoints:
   GET /api/profile?node_id=N&worker_id=W
                    on-demand stack dump of one worker (the reference's
                    py-spy role, served by the worker in-process)
+  GET /api/profile[?role=head|node|worker&node=N&worker=W&top=K]
+                   without node_id+worker_id: aggregated continuous
+                   collapsed-stack profiles from the head's ProfileStore
+                   (util/stack_profiler.py; every process samples at
+                   profile_hz and ships windows over telemetry_push)
 """
 
 from __future__ import annotations
@@ -189,16 +194,24 @@ class Dashboard:
                         return
                     if parsed.path == "/api/profile":
                         q = parse_qs(parsed.query)
-                        if not q.get("node_id") or not q.get("worker_id"):
-                            self._send(400, json.dumps(
-                                {"error": "need node_id and worker_id "
-                                          "query params"}).encode(),
-                                "application/json")
-                            return
-                        addr = self._node_addr(q["node_id"][0])
-                        data = pool.get(addr).call(
-                            "profile_worker",
-                            {"worker_id": q["worker_id"][0]}, timeout=15)
+                        if q.get("node_id") and q.get("worker_id"):
+                            # legacy surface: on-demand formatted stack
+                            # dump of ONE worker via its node daemon
+                            addr = self._node_addr(q["node_id"][0])
+                            data = pool.get(addr).call(
+                                "profile_worker",
+                                {"worker_id": q["worker_id"][0]},
+                                timeout=15)
+                        else:
+                            # aggregated continuous profiles from the
+                            # head's ProfileStore (collapsed stacks per
+                            # process, tagged role/node/worker)
+                            data = client.call("profiles_dump", {
+                                "role": q.get("role", [""])[0],
+                                "node": q.get("node", [""])[0],
+                                "worker": q.get("worker", [""])[0],
+                                "top": int(q.get("top", ["0"])[0] or 0),
+                            }, timeout=10)
                         self._send(200, json.dumps(
                             data, default=str).encode(), "application/json")
                         return

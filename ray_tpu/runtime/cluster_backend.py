@@ -864,6 +864,14 @@ class ClusterBackend:
                                            daemon=True,
                                            name=f"{role}-telemetry")
         self._telemetry.start()
+        # continuous wall-clock stack sampler for this process (worker or
+        # driver); windows drain through _flush_telemetry into the head's
+        # ProfileStore ('python -m ray_tpu profile')
+        try:
+            from ray_tpu.util import stack_profiler
+            stack_profiler.ensure_started()
+        except Exception:  # noqa: BLE001 — profiling never stops connect
+            pass
 
     def _defer_actor_flush(self, sub) -> None:
         if not self._native_transport:
@@ -941,15 +949,20 @@ class ClusterBackend:
             reqlog = sys.modules.get("ray_tpu.llm.request_log")
             llm_requests = reqlog.drain_all_exports() \
                 if reqlog is not None else []
+            # this process's collapsed-stack profiler window (None when
+            # profiling is disabled or nothing was sampled)
+            from ray_tpu.util import stack_profiler
+            profiles = stack_profiler.drain_export()
             if snap or events or tracked or samples or llm_requests \
-                    or journal:
+                    or journal or profiles:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
                     "node": self.local_node_id,
                     "metrics": snap, "events": events,
                     "objects": objects, "samples": samples,
-                    "llm_requests": llm_requests, "journal": journal})
+                    "llm_requests": llm_requests, "journal": journal,
+                    "profiles": profiles})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 

@@ -328,6 +328,18 @@ class Head:
         from ray_tpu.runtime.event_journal import ClusterEventJournal
         self.journal = ClusterEventJournal(
             capacity=cfg.cluster_event_journal_size)
+        # cluster-wide sampling-profiler plane (util/stack_profiler.py):
+        # every process's collapsed-stack exports ride telemetry_push into
+        # per-process rings here, merged on read by profiles_dump — and the
+        # head profiles ITSELF (the 1.7k-LoC Python policy the slow
+        # control-plane rows blame needs frame-level evidence)
+        from ray_tpu.util import stack_profiler as profiler_mod
+        self._profiler_mod = profiler_mod
+        self._profiles = profiler_mod.ProfileStore()
+        try:
+            profiler_mod.ensure_started()
+        except Exception:  # noqa: BLE001 — profiling must never stop boot
+            pass
         # unserviceable demand, deduped per (requester, shape): each
         # submitter polls its shape every ~0.2s, so per-poll appends would
         # over-count 25x per window (the autoscaler's demand signal;
@@ -370,6 +382,8 @@ class Head:
             "requests_dump": self._h_requests_dump,
             "events_dump": self._h_events_dump,
             "objects_dump": self._h_objects_dump,
+            "profiles_dump": self._h_profiles_dump,
+            "profiles_record": self._h_profiles_record,
             "journal_record": self._h_journal_record,
             "autoscaler_state": self._h_autoscaler_state,
             "pubsub_publish": lambda p, c: self.pubsub.publish(
@@ -1654,6 +1668,12 @@ class Head:
             # a big batch never stalls lease/actor RPCs)
             self._timeseries.ingest(p.get("node") or p["worker"],
                                     p["samples"])
+        if p.get("profiles"):
+            # collapsed-stack windows -> per-process profile rings (own
+            # lock, outside _lock for the same reason)
+            self._profiles.ingest(
+                p["worker"], p["profiles"], role=p.get("role", ""),
+                node=(p.get("node") or "")[:12], worker=p["worker"][:12])
         for ev in p.get("journal", ()):
             # worker-originated cluster events (spill overflows): the
             # journal assigns seq/ts at arrival so ordering is the head's
@@ -1681,6 +1701,81 @@ class Head:
         etype = p.pop("type", "") or "event"
         trace_id = p.pop("trace_id", "")
         return self.journal.record(etype, trace_id=trace_id, **p)["seq"]
+
+    # ------------------------------------------------------------ profiles
+
+    @staticmethod
+    def _proc_row(key, role, node, worker, export):
+        e = export or {}
+        return {"key": key, "role": role, "node": node, "worker": worker,
+                "pid": e.get("pid"), "ts": e.get("ts"),
+                "samples": int(e.get("samples") or 0),
+                "dropped": int(e.get("dropped") or 0),
+                "window_s": float(e.get("window_s") or 0.0),
+                "stacks": e.get("stacks") or {}}
+
+    def _h_profiles_dump(self, p, ctx):
+        """Merged per-process collapsed-stack profiles from the
+        ProfileStore (filters: role/node/worker substring, top-N
+        stacks per process)."""
+        p = p or {}
+        try:
+            # the head drains its OWN continuous profile at read time —
+            # unlike workers/nodes it has no telemetry flush to ride
+            export = self._profiler_mod.drain_export()
+            if export:
+                self._profiles.ingest("head", export, role="head")
+        except Exception:  # noqa: BLE001 — profiling never fails a dump
+            pass
+        return self._profiles.dump(
+            role=p.get("role", ""), node=p.get("node", ""),
+            worker=p.get("worker", ""), top=int(p.get("top", 0) or 0))
+
+    def _h_profiles_record(self, p, ctx):
+        """On-demand burst capture fanned out cluster-wide ('profile
+        --record S --hz N'): the head bursts itself while every selected
+        node daemon bursts itself and its workers in parallel. Returns
+        merged per-process rows in the profiles_dump shape, bypassing
+        the store (a burst is a one-shot answer, not history)."""
+        p = p or {}
+        seconds = max(0.1, min(float(p.get("seconds", 2.0) or 2.0), 30.0))
+        hz = float(p.get("hz", 99.0) or 99.0)
+        role = p.get("role", "")
+        node_f = p.get("node", "")
+        worker_f = p.get("worker", "")
+        with self._lock:
+            nodes = [(n.node_id, n.address)
+                     for n in self._nodes.values() if n.alive]
+        futs = []
+        if role in ("", "node", "worker"):
+            payload = {"seconds": seconds, "hz": hz, "worker": worker_f,
+                       "include_self": role in ("", "node")
+                       and not worker_f,
+                       "include_workers": role in ("", "worker")}
+            for node_id, addr in nodes:
+                if node_f and not node_id.startswith(node_f):
+                    continue
+                try:
+                    futs.append(self._node_clients.get(addr).call_async(
+                        "profile_burst", payload))
+                except Exception:  # noqa: BLE001 — node dying mid-record
+                    pass
+        procs = []
+        if role in ("", "head") and not node_f and not worker_f:
+            from ray_tpu.util.stack_profiler import burst_capture
+            procs.append(self._proc_row(
+                "head", "head", "", "", burst_capture(seconds, hz)))
+        for fut in futs:
+            try:
+                reply = fut.result(timeout=seconds + 15.0)
+            except Exception:  # noqa: BLE001 — skip unreachable nodes
+                continue
+            for row in (reply or {}).get("procs", ()):
+                procs.append(self._proc_row(
+                    row.get("key", ""), row.get("role", ""),
+                    row.get("node", ""), row.get("worker", ""),
+                    row.get("export")))
+        return {"procs": procs}
 
     def _h_objects_dump(self, p, ctx):
         """Aggregated object directory: every reporter's reconciled rows

@@ -16,9 +16,12 @@ workers and false in the node daemon and on CPU-only hosts.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 Sample = dict  # {"metric": str, "value": float, "tags": {str: str}}
 
@@ -165,6 +168,8 @@ class HardwareSampler:
         self._prev_node_cpu: Optional[tuple] = None          # (busy, total)
         self._prev_pid_ticks: Dict[int, tuple] = {}          # pid -> (t, ticks)
         self._prev_cg_usec: Optional[tuple] = None           # (t, usec)
+        # probes that already logged a failure (warn once, not per period)
+        self._warned_probes: set = set()
 
     # -- individual probes (each returns a list of samples) ---------------
 
@@ -211,11 +216,19 @@ class HardwareSampler:
             if ticks is not None:
                 prev = self._prev_pid_ticks.get(pid)
                 self._prev_pid_ticks[pid] = (now, ticks)
-                if prev is not None and now > prev[0]:
+                if prev is not None and now > prev[0] \
+                        and ticks >= prev[1]:
+                    # ticks < prev means the pid was REUSED between
+                    # passes (counter restarted from ~0): drop the
+                    # garbage delta and let the fresh baseline above
+                    # seed the next pass. Clamp the emitted percentage
+                    # to the host's physical ceiling — a tick-counter
+                    # hiccup must never graph a 4000%-CPU worker.
                     pct = 100.0 * (ticks - prev[1]) / self._hz \
                         / (now - prev[0])
+                    pct = min(max(0.0, pct), 100.0 * self._ncpu)
                     out.append({"metric": "worker_cpu_percent",
-                                "value": round(max(0.0, pct), 2),
+                                "value": round(pct, 2),
                                 "tags": tags})
         # forget exited pids so the delta table doesn't grow with churn
         for pid in [p for p in self._prev_pid_ticks if p not in live_pids]:
@@ -263,14 +276,26 @@ class HardwareSampler:
     def sample(self) -> List[Sample]:
         """One sampling pass; each call emits the current gauge batch
         (CPU percentages need a prior pass to have a delta, so the very
-        first call omits them)."""
+        first call omits them).
+
+        Probes are ISOLATED: one raising probe (e.g. tpu_memory_samples
+        mid-backend-shutdown) loses only its own gauges for that pass,
+        never the whole batch — and logs once, not once per period."""
         out: List[Sample] = []
-        out += self._node_cpu()
-        out += self._node_mem()
-        out += self._worker_samples()
-        out += self._cgroup_samples()
-        out += self._arena_samples()
-        out += tpu_memory_samples()
+        for name, probe in (("node_cpu", self._node_cpu),
+                            ("node_mem", self._node_mem),
+                            ("workers", self._worker_samples),
+                            ("cgroup", self._cgroup_samples),
+                            ("arena", self._arena_samples),
+                            ("tpu", tpu_memory_samples)):
+            try:
+                out += probe()
+            except Exception as e:  # noqa: BLE001 — probe fault boundary
+                if name not in self._warned_probes:
+                    self._warned_probes.add(name)
+                    logger.warning(
+                        "hardware probe %s failed (suppressing repeats "
+                        "for this probe): %r", name, e)
         ts = time.time()
         for s in out:
             s.setdefault("ts", ts)

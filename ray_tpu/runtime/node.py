@@ -127,6 +127,7 @@ class NodeDaemon:
             "store_stats": lambda p, c: self.store.stats(),
             "node_stats": self._h_node_stats,
             "profile_worker": self._h_profile_worker,
+            "profile_burst": self._h_profile_burst,
             "list_workers": self._h_list_workers,
             "worker_fate": self._h_worker_fate,
             "ping": lambda p, c: "pong",
@@ -171,6 +172,13 @@ class NodeDaemon:
             # ring buffers (reference: reporter_agent.py poll loop)
             threading.Thread(target=self._hw_sampler_loop, daemon=True,
                              name="node-hw-sampler").start()
+        # continuous wall-clock stack sampler; exports ride the hardware
+        # sampler's telemetry_push into the head's ProfileStore
+        try:
+            from ray_tpu.util import stack_profiler
+            stack_profiler.ensure_started()
+        except Exception:  # noqa: BLE001 — profiling never stops boot
+            pass
         for _ in range(cfg.worker_pool_prestart):
             self._spawn_worker()
 
@@ -462,6 +470,7 @@ class NodeDaemon:
         (util/timeseries.py). Loss-tolerant by design: a down head just
         drops samples until it returns."""
         from ray_tpu.runtime.hw_sampler import HardwareSampler
+        from ray_tpu.util import stack_profiler
         period = config_mod.GlobalConfig.hw_sampler_period_s
 
         def _worker_rows():
@@ -479,7 +488,10 @@ class NodeDaemon:
         while not self._stopped.wait(period):
             try:
                 samples = sampler.sample()
-                if samples:
+                # the daemon's own collapsed-stack window rides the same
+                # push (None when profiling is off or nothing sampled)
+                profiles = stack_profiler.drain_export()
+                if samples or profiles:
                     # the metrics snapshot rides along so daemon-side
                     # counters (pull-out bytes, spill restores served)
                     # aggregate at the head like any worker's
@@ -487,7 +499,7 @@ class NodeDaemon:
                         "telemetry_push", {
                             "worker": f"node:{self.node_id[:12]}",
                             "node": self.node_id, "role": "node",
-                            "samples": samples,
+                            "samples": samples, "profiles": profiles,
                             "metrics": metrics_mod.snapshot()})
             except Exception:  # noqa: BLE001 — head down: keep sampling
                 pass
@@ -758,6 +770,46 @@ class NodeDaemon:
         if addr is None:
             raise ValueError(f"no live worker {wid.hex()} on this node")
         return self._clients.get(addr).call("dump_stacks", timeout=10.0)
+
+    def _h_profile_burst(self, p, ctx):
+        """Burst-capture leg of `profiles_record`: this daemon bursts
+        itself while every (filtered) live worker bursts in parallel;
+        rows come back tagged with node/worker ids so the head can
+        attribute frames without knowing our topology."""
+        from ray_tpu.util.stack_profiler import burst_capture
+        p = p or {}
+        seconds = max(0.1, min(float(p.get("seconds", 2.0) or 2.0), 30.0))
+        hz = float(p.get("hz", 99.0) or 99.0)
+        worker_f = p.get("worker", "")
+        node12 = self.node_id[:12]
+        futs = []
+        if p.get("include_workers", True):
+            payload = {"seconds": seconds, "hz": hz}
+            with self._lock:
+                rows = [(WorkerID(w.worker_id).hex(), w.address)
+                        for w in self._workers.values()
+                        if w.state != "dead" and w.address]
+            for wid, addr in rows:
+                if worker_f and not wid.startswith(worker_f):
+                    continue
+                try:
+                    futs.append((wid, self._clients.get(addr).call_async(
+                        "profile_burst", payload)))
+                except Exception:  # noqa: BLE001 — worker exiting
+                    pass
+        procs = []
+        if p.get("include_self", True):
+            procs.append({"key": f"node:{node12}", "role": "node",
+                          "node": node12, "worker": "",
+                          "export": burst_capture(seconds, hz)})
+        for wid, fut in futs:
+            try:
+                export = fut.result(timeout=seconds + 10.0)
+            except Exception:  # noqa: BLE001 — worker died mid-burst
+                continue
+            procs.append({"key": wid, "role": "worker", "node": node12,
+                          "worker": wid[:12], "export": export})
+        return {"procs": procs}
 
     # ----------------------------------------------------------- object plane
 

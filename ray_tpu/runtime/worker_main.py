@@ -769,6 +769,16 @@ def _dump_stacks() -> dict:
             "stacks": stacks}
 
 
+def _profile_burst(p, ctx) -> dict:
+    """Synchronous collapsed-stack burst of this worker's threads (the
+    worker leg of 'profile --record'; runs on the RPC lane so the task
+    thread under observation is never perturbed)."""
+    from ray_tpu.util.stack_profiler import burst_capture
+    p = p or {}
+    return burst_capture(float(p.get("seconds", 2.0) or 2.0),
+                         float(p.get("hz", 99.0) or 99.0))
+
+
 def main() -> None:
     node_addr, head_addr, shm_name, worker_hex, cfg_json = sys.argv[1:6]
     config_mod.GlobalConfig.apply(json.loads(cfg_json))
@@ -815,6 +825,9 @@ def main() -> None:
         "dag_start_loop": executor.handle_dag_start_loop,
         "ping": lambda p, c: "pong",
         "dump_stacks": lambda p, c: _dump_stacks(),
+        # on-demand burst capture (node daemon fans 'profiles_record'
+        # here); samples THIS worker's task threads from the RPC lane
+        "profile_burst": _profile_burst,
         "exit": lambda p, c: os._exit(0),
     })
     backend.server.inline_methods.add("push_task")

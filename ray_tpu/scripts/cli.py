@@ -378,6 +378,73 @@ def cmd_top(args) -> int:
         return 0
 
 
+def cmd_profile(args) -> int:
+    """Cluster-wide sampling profiles: merged collapsed stacks from the
+    head's ProfileStore (continuous, every process at profile_hz), or a
+    --record burst fanned out to head + node daemons + workers. Renders
+    a self/cumulative top-frames table, --flame collapsed output
+    (flamegraph.pl / speedscope paste), or --speedscope JSON."""
+    from ray_tpu.util.stack_profiler import (merge_stacks, to_speedscope,
+                                             top_frames)
+    address = load_address(args.address)
+    payload = {"role": "head" if args.head else "",
+               "node": args.node or "", "worker": args.worker or ""}
+    client = _client(address)
+    if args.record:
+        payload.update({"seconds": args.record, "hz": args.hz})
+        data = client.call("profiles_record", payload,
+                           timeout=args.record + 30.0)
+    else:
+        data = client.call("profiles_dump", payload, timeout=10)
+    procs = (data or {}).get("procs") or []
+    if args.format == "json":
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    stacks = merge_stacks([p.get("stacks") for p in procs])
+    samples = sum(int(p.get("samples") or 0) for p in procs)
+    dropped = sum(int(p.get("dropped") or 0) for p in procs)
+    if args.flame:
+        for stack, count in sorted(stacks.items(),
+                                   key=lambda kv: (-kv[1], kv[0])):
+            print(f"{stack} {count}")
+        return 0
+    if args.speedscope is not None:
+        name = "ray_tpu burst" if args.record else "ray_tpu continuous"
+        out = json.dumps(to_speedscope(stacks, name=name))
+        if args.speedscope == "-":
+            print(out)
+        else:
+            with open(args.speedscope, "w") as f:
+                f.write(out)
+            print(f"wrote {args.speedscope} ({len(stacks)} stacks, "
+                  f"{samples} samples)", file=sys.stderr)
+        return 0
+    if not procs:
+        print("no profiles yet — is profile_enabled on, and has a "
+              "telemetry flush landed? (try --record 2)")
+        return 1
+    mode = (f"burst {args.record:g}s @ {args.hz:g}Hz" if args.record
+            else "continuous")
+    print(f"{len(procs)} process(es), {samples} samples"
+          + (f" ({dropped} dropped on table overflow)" if dropped else "")
+          + f"  [{mode}]")
+    for r in sorted(procs, key=lambda r: -(r.get("samples") or 0)):
+        where = r.get("node") or ""
+        label = r.get("role") or "?"
+        ident = r.get("worker") or r.get("key", "")[:12]
+        print(f"  {label:<7}{ident:<14}node={where or '-':<14}"
+              f"samples={r.get('samples', 0):<8}"
+              f"window={r.get('window_s', 0.0):g}s")
+    print()
+    print(f"{'self':>7} {'self%':>6} {'cum':>7} {'cum%':>6}  frame")
+    for row in top_frames(stacks, args.top):
+        sp = 100.0 * row["self"] / max(1, samples)
+        cp = 100.0 * row["cum"] / max(1, samples)
+        print(f"{row['self']:>7} {sp:>5.1f}% {row['cum']:>7} "
+              f"{cp:>5.1f}%  {row['frame']}")
+    return 0
+
+
 def cmd_memory(args) -> int:
     """Cluster object-store directory: every tracked object with size,
     role (primary/secondary/spilled), owner, age and pin counts, grouped
@@ -761,6 +828,35 @@ def main(argv=None) -> int:
                     help="repaint continuously until ctrl-c")
     sp.add_argument("--interval", type=float, default=2.0)
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("profile",
+                        help="cluster-wide sampling profiles: top hot "
+                             "frames, --flame collapsed stacks, or "
+                             "--speedscope JSON (continuous, or an "
+                             "on-demand --record burst)")
+    sp.add_argument("--address")
+    sp.add_argument("--head", action="store_true",
+                    help="only the head process")
+    sp.add_argument("--node", help="only processes on this node id "
+                                   "(prefix match)")
+    sp.add_argument("--worker", help="only this worker id (prefix match)")
+    sp.add_argument("--record", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="burst-capture for SECONDS at --hz across the "
+                         "selected processes instead of reading the "
+                         "continuous profile")
+    sp.add_argument("--hz", type=float, default=99.0,
+                    help="burst sampling rate (with --record)")
+    sp.add_argument("--top", type=int, default=20,
+                    help="rows in the frame table")
+    sp.add_argument("--flame", action="store_true",
+                    help="print merged collapsed stacks ('stack N' "
+                         "lines; flamegraph.pl / speedscope input)")
+    sp.add_argument("--speedscope", metavar="FILE",
+                    help="write speedscope JSON to FILE ('-' = stdout)")
+    sp.add_argument("--format", choices=["plain", "json"],
+                    default="plain")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("memory",
                         help="object-store directory: per-object rows "

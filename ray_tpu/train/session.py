@@ -40,6 +40,16 @@ class TrainContext:
         # step-hiccup telemetry: steady-state step time (EMA over steps
         # with no save in flight) vs the worst step seen during a save
         self._steady_step_ema: Optional[float] = None
+        # cross-host straggler attribution: every rank publishes its
+        # per-phase step times under this run-scoped KV prefix; rank 0
+        # ("host 0") compares them into train_phase_skew_s gauges and
+        # train_straggler journal events (trace-id-linked per run)
+        import hashlib
+        run_key = hashlib.md5(storage_path.encode()).hexdigest()[:8]
+        self._phase_kv_prefix = f"train/phases/{run_key}"
+        self._trace_id = f"train:{run_key}"
+        self._last_phase_t: Optional[float] = None
+        self._straggler_hosts: set = set()
 
     # -- API used inside train_loop_per_worker ------------------------------
     def get_world_size(self) -> int:
@@ -62,6 +72,12 @@ class TrainContext:
         CheckpointConfig.async_save the call only pays the device→host
         copy; otherwise rank 0 returns with the manifest committed.
         """
+        from ray_tpu.util.fault_injector import fire
+        fire("train.report")
+        # rank-addressable point: chaos tests slow ONE host of a gang
+        # (RTPU_FAULT_INJECT="train.report.rank1=sleep:0.4") to prove the
+        # straggler attribution path end-to-end
+        fire(f"train.report.rank{self.rank}")
         self.step += 1
         entry = dict(metrics)
         entry["_step"] = self.step
@@ -81,6 +97,7 @@ class TrainContext:
         self.reported.append(entry)
         if self.rank == 0:
             self._emit_step_gauges(metrics)
+        self._publish_host_phases(metrics)
 
     def _emit_step_gauges(self, metrics: Dict[str, Any]) -> None:
         """Built-in L5 train telemetry (rank 0): step time and throughput
@@ -125,6 +142,100 @@ class TrainContext:
                         float(secs), tags={"phase": str(phase)})
         except Exception:  # noqa: BLE001
             pass
+
+    # -- cross-host straggler attribution ------------------------------------
+
+    def _publish_host_phases(self, metrics: Dict[str, Any]) -> None:
+        """Every rank publishes its latest per-phase step times (user
+        `phases` dict + the implicit wall-clock 'step' phase) to the head
+        KV under a run-scoped key; rank 0 compares all hosts each report.
+        Best-effort telemetry: never fails or slows the training loop
+        beyond one small KV write (plus world_size reads on rank 0)."""
+        try:
+            from ray_tpu.core.config import GlobalConfig
+            factor = float(GlobalConfig.train_straggler_factor)
+        except Exception:  # noqa: BLE001
+            factor = 0.0
+        if self.world_size <= 1 or factor <= 0:
+            return
+        now = time.monotonic()
+        prev, self._last_phase_t = self._last_phase_t, now
+        phases: Dict[str, float] = {}
+        user = metrics.get("phases")
+        if isinstance(user, dict):
+            for k, v in user.items():
+                try:
+                    phases[str(k)] = float(v)
+                except (TypeError, ValueError):
+                    pass
+        if prev is not None and now > prev:
+            # the implicit whole-step phase: detection works even for
+            # loops that never time their own phases
+            phases["step"] = now - prev
+        if not phases:
+            return
+        try:
+            from ray_tpu.core.worker import global_worker
+            backend = getattr(global_worker, "backend", None)
+            if backend is None:
+                return
+            backend.kv_put(
+                f"{self._phase_kv_prefix}/{self.rank}",
+                {"step": self.step, "ts": time.time(), "phases": phases})
+            if self.rank == 0:
+                self._compare_host_phases(backend, factor, phases)
+        except Exception:  # noqa: BLE001 — telemetry must never fail a step
+            pass
+
+    def _compare_host_phases(self, backend, factor: float,
+                             my_phases: Dict[str, float]) -> None:
+        """Host 0's comparison pass: latest phase times of every host
+        side by side -> train_phase_skew_s{phase,host} gauges; a host
+        slower than the fastest by more than `factor` lands ONE
+        train_straggler journal event per excursion (re-armed when the
+        host catches back up), trace-id-linked to this run."""
+        per_host: Dict[int, Dict[str, float]] = {0: my_phases}
+        cutoff = time.time() - 60.0
+        for rank in range(1, self.world_size):
+            v = backend.kv_get(f"{self._phase_kv_prefix}/{rank}")
+            # latest window per host, guarded by staleness (a dead or
+            # not-yet-reporting host must not be compared): steps may
+            # legitimately drift apart when hosts run uncoupled
+            if isinstance(v, dict) and v.get("phases") \
+                    and float(v.get("ts", 0)) >= cutoff:
+                per_host[rank] = v["phases"]
+        if len(per_host) < 2:
+            return
+        from ray_tpu.util import metrics as metrics_mod
+        gauge = metrics_mod.train_phase_skew_gauge()
+        all_phases = set()
+        for p in per_host.values():
+            all_phases.update(p)
+        stragglers: Dict[int, Dict[str, float]] = {}
+        for phase in sorted(all_phases):
+            times = {h: float(p[phase]) for h, p in per_host.items()
+                     if phase in p}
+            if len(times) < 2:
+                continue
+            fastest = min(times.values())
+            for host, t in times.items():
+                gauge.set(max(0.0, t - fastest),
+                          tags={"phase": phase, "host": str(host)})
+                if fastest > 1e-6 and t / fastest > factor:
+                    stragglers.setdefault(host, {})[phase] = \
+                        round(t / fastest, 2)
+        for host, worst in stragglers.items():
+            if host not in self._straggler_hosts:
+                self._journal_straggler(host, worst)
+        self._straggler_hosts = set(stragglers)
+
+    def _journal_straggler(self, host: int,
+                           worst: Dict[str, float]) -> None:
+        from ray_tpu.train.checkpoint import _journal
+        _journal("train_straggler", trace_id=self._trace_id,
+                 host=str(host), rank=host, step=self.step,
+                 world_size=self.world_size,
+                 slowdown_factors=worst)
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.restore_from

@@ -269,6 +269,38 @@ def train_phase_time_gauge() -> Gauge:
                  tag_keys=("phase",))
 
 
+def train_phase_skew_gauge() -> Gauge:
+    """Cross-host straggler attribution (rank 0): how many seconds each
+    host's train phase ran BEHIND the fastest host that step, tagged
+    {phase, host}. A host whose factor over the fastest exceeds
+    `train_straggler_factor` also lands a `train_straggler` event in the
+    cluster journal naming it (the 'which host is dragging the gang'
+    question TorchTitan-scale multi-slice runs ask first)."""
+    return Gauge("train_phase_skew_s",
+                 description="seconds each host's train phase lags the "
+                             "fastest host (rank 0 comparison)",
+                 tag_keys=("phase", "host"))
+
+
+def profile_samples_total_counter() -> Counter:
+    """Thread-stack samples folded by this process's continuous
+    wall-clock profiler (util/stack_profiler.py) — the denominator every
+    collapsed-stack count is a share of."""
+    return Counter("profile_samples_total",
+                   description="stack samples folded by the continuous "
+                               "profiler")
+
+
+def profile_dropped_samples_total_counter() -> Counter:
+    """Samples dropped because the bounded collapsed-stack table was
+    full (profile_table_size distinct stacks). Non-zero means the
+    profile under-reports cold stacks — raise the table size or flush
+    more often; hot frames are unaffected."""
+    return Counter("profile_dropped_samples_total",
+                   description="profiler samples dropped on stack-table "
+                               "overflow")
+
+
 def train_checkpoint_write_seconds_histogram() -> Histogram:
     """Wall seconds of one host's checkpoint shard write (serialize +
     upload, measured on the background writer thread — the time the

@@ -404,6 +404,32 @@ def test_checkpoint_and_storage_metric_names_follow_convention():
     assert len(names) == len(factories)
 
 
+def test_profile_and_skew_metric_names_follow_convention():
+    """Same lint for the profiler-plane series: profile_* counters carry
+    a sanctioned unit suffix; train_phase_skew_s follows the existing
+    train gauge `_s` convention (train_step_time_s, train_phase_time_s)
+    and is tagged (phase, host) so host 0's comparison can attribute
+    skew to one phase on one host."""
+    import re
+
+    from ray_tpu.util import metrics as m
+
+    pat = re.compile(
+        r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)*_(bytes|seconds|total|count)$")
+    names = set()
+    for f in (m.profile_samples_total_counter,
+              m.profile_dropped_samples_total_counter):
+        inst = f()
+        assert pat.match(inst.name), inst.name
+        assert inst.name.startswith("profile_"), inst.name
+        names.add(inst.name)
+    assert len(names) == 2
+
+    skew = m.train_phase_skew_gauge()
+    assert re.match(r"^train_[a-z0-9_]+_s$", skew.name), skew.name
+    assert tuple(skew.tag_keys) == ("phase", "host")
+
+
 def test_task_event_buffer_ring_eviction():
     """Satellite: the span buffer is a ring — at MAX_BUFFER the OLDEST
     spans are evicted (not the newest refused) and the __dropped__

@@ -103,6 +103,73 @@ def test_hw_sampler_fake_proc_tree(tmp_path):
     assert sampler._prev_pid_ticks == {}
 
 
+def test_hw_sampler_probe_isolation(tmp_path, caplog):
+    """One raising probe loses only its own gauges for the pass — the
+    rest of the batch still lands — and it warns once, not per period."""
+    import logging
+    _fake_proc(tmp_path, busy=200, total=1000, pid_ticks=0)
+    sampler = HardwareSampler(procfs=str(tmp_path / "proc"))
+
+    def boom():
+        raise RuntimeError("probe exploded")
+
+    sampler._node_cpu = boom  # injected fault in the first probe
+    with caplog.at_level(logging.WARNING, "ray_tpu.runtime.hw_sampler"):
+        first = {s["metric"] for s in sampler.sample()}
+        second = {s["metric"] for s in sampler.sample()}
+    # other probes survived both passes
+    assert "node_mem_total_bytes" in first
+    assert "node_mem_total_bytes" in second
+    warnings = [r for r in caplog.records if "node_cpu" in r.getMessage()]
+    assert len(warnings) == 1  # warn-once, repeats suppressed
+
+
+def test_hw_sampler_pid_reuse_drops_sample(tmp_path):
+    """pid reused between passes (cpu tick counter restarts near 0) must
+    DROP the sample — never emit a huge-negative or garbage delta — and
+    the fresh baseline seeds the next pass normally."""
+    import os
+    hz = os.sysconf("SC_CLK_TCK")
+    clock = [100.0]
+    _fake_proc(tmp_path, busy=200, total=1000, pid_ticks=50 * hz)
+    sampler = HardwareSampler(
+        procfs=str(tmp_path / "proc"),
+        workers=lambda: [{"worker_id": "w1", "pid": 4242, "state": "a"}],
+        clock=lambda: clock[0])
+    sampler.sample()  # baseline at 50*hz ticks
+
+    # new process under the same pid: ticks restarted from ~0
+    clock[0] += 2.0
+    _fake_proc(tmp_path, busy=400, total=1800, pid_ticks=1 * hz)
+    reused = {s["metric"] for s in sampler.sample()}
+    assert "worker_cpu_percent" not in reused  # dropped, not garbage
+    # but a fresh baseline was recorded: the NEXT delta is valid again
+    clock[0] += 2.0
+    _fake_proc(tmp_path, busy=600, total=2600, pid_ticks=3 * hz)
+    third = {s["metric"]: s for s in sampler.sample()}
+    assert third["worker_cpu_percent"]["value"] == pytest.approx(
+        100.0, abs=0.5)
+
+
+def test_hw_sampler_cpu_percent_clamped(tmp_path):
+    """A tick-counter hiccup can't graph a 4000%-CPU worker: the emitted
+    percentage is clamped to 100 * ncpu."""
+    import os
+    hz = os.sysconf("SC_CLK_TCK")
+    clock = [100.0]
+    _fake_proc(tmp_path, busy=200, total=1000, pid_ticks=0)
+    sampler = HardwareSampler(
+        procfs=str(tmp_path / "proc"),
+        workers=lambda: [{"worker_id": "w1", "pid": 4242, "state": "a"}],
+        clock=lambda: clock[0])
+    sampler.sample()
+    # 1000*hz ticks in 2s of wall clock => 50000% uncapped
+    clock[0] += 2.0
+    _fake_proc(tmp_path, busy=400, total=1800, pid_ticks=1000 * hz)
+    got = {s["metric"]: s for s in sampler.sample()}
+    assert got["worker_cpu_percent"]["value"] <= 100.0 * sampler._ncpu
+
+
 # ------------------------------------------------------------------ rings
 
 def test_timeseries_ring_eviction():
