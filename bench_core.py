@@ -385,6 +385,41 @@ def main() -> None:
         ray_tpu.kill(prof_on)
         ray_tpu.kill(prof_off)
 
+    # structured-log-plane overhead A/B (<2% acceptance): the SAME
+    # small-task batch with the log plane on (default: dual-sink logger
+    # + tee'd stdio feeding the ring) vs off via env override — same
+    # best-of-alternating protocol as the profiler knob above
+    if not pattern or pattern in "logplane_overhead_ab":
+        logs_on = Actor.options(runtime_env={
+            "env_vars": {"RTPU_log_plane_enabled": "1"}}).remote()
+        logs_off = Actor.options(runtime_env={
+            "env_vars": {"RTPU_log_plane_enabled": "0"}}).remote()
+        ray_tpu.get([logs_on.small_value_batch.remote(4),
+                     logs_off.small_value_batch.remote(4)])
+        best_on = best_off = 0.0
+        for _ in range(max(4, REPS)):
+            best_on = max(best_on, _measure(
+                lambda: ray_tpu.get(
+                    logs_on.small_value_batch.remote(500)), 500))
+            best_off = max(best_off, _measure(
+                lambda: ray_tpu.get(
+                    logs_off.small_value_batch.remote(500)), 500))
+        ratio = round(best_on / best_off, 4) if best_off else None
+        PROFILE_RESULTS["logplane_overhead_ab"] = {
+            "on_ops_s": round(best_on, 2),
+            "off_ops_s": round(best_off, 2),
+            "on_vs_off": ratio,
+            "overhead_pct": round((1.0 - ratio) * 100.0, 2)
+            if ratio else None,
+            "protocol": "best-of-alternating 1-submitter/500-task "
+                        "windows, log plane on vs "
+                        "RTPU_log_plane_enabled=0"}
+        print(json.dumps({"metric": "logplane_overhead_ab",
+                          **PROFILE_RESULTS["logplane_overhead_ab"]}),
+              flush=True)
+        ray_tpu.kill(logs_on)
+        ray_tpu.kill(logs_off)
+
     timeit("single_client_tasks_sync",
            lambda: ray_tpu.get(small_value.remote()))
 

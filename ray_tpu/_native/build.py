@@ -45,7 +45,8 @@ def build(verbose: bool = False) -> str:
         return LIB_PATH
     base_cmd = ["g++"] + CXXFLAGS + [os.path.join(SRC_DIR, s) for s in SOURCES]
     if verbose:
-        print(" ".join(base_cmd + ["-o", LIB_PATH, "-lrt"]), file=sys.stderr)
+        sys.stderr.write(
+            " ".join(base_cmd + ["-o", LIB_PATH, "-lrt"]) + "\n")
     # Serialize concurrent builds (several workers may import simultaneously).
     lockfile = LIB_PATH + ".lock"
     import fcntl
@@ -64,4 +65,4 @@ def build(verbose: bool = False) -> str:
 
 if __name__ == "__main__":
     build(verbose=True)
-    print(LIB_PATH)
+    sys.stdout.write(LIB_PATH + "\n")

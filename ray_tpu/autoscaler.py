@@ -36,6 +36,7 @@ import dataclasses
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -648,7 +649,8 @@ def main() -> None:
         head_addr, provider, node_types=node_types,
         idle_timeout_s=float(opts.get("idle_timeout_s", 10.0)),
         poll_period_s=float(opts.get("poll_period_s", 0.25))).start()
-    print("RTPU_AUTOSCALER_READY", flush=True)
+    sys.stdout.write("RTPU_AUTOSCALER_READY\n")
+    sys.stdout.flush()
     try:
         while True:
             time.sleep(1.0)

@@ -99,6 +99,13 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "profile_enabled": (bool, True, "continuous wall-clock stack sampler (util/stack_profiler.py) in every process — head, node daemons, workers, drivers; collapsed-stack profiles ride telemetry_push into the head's ProfileStore ('python -m ray_tpu profile'); disable to A/B the sampling overhead (BENCH_profile.json records it at <2%)"),
     "profile_hz": (float, 19.0, "continuous profiler sampling rate (Hz); the prime-ish default never phase-locks with the 1-2s periodic loops it observes, so those loops sample in proportion to the time they actually burn; burst captures ('profile --record S --hz N') pick their own rate"),
     "profile_table_size": (int, 512, "distinct collapsed stacks held per process between telemetry flushes; samples landing on new stacks once the table is full are dropped and counted exactly (the profile keeps an honest denominator: profile_dropped_samples_total)"),
+    "log_plane_enabled": (bool, True, "structured log plane (util/log_plane.py) in every process — head, node daemons, workers, drivers; JSON-lines records dual-sunk into the per-node session log directory (rotated files) and a bounded ring riding telemetry_push into the head's LogStore ('python -m ray_tpu logs'); disable to A/B the logging overhead"),
+    "log_ring_records": (int, 1024, "log records buffered per process between telemetry flushes; overflow drops the OLDEST and counts it exactly (log_dropped_records_total — the export invariant 'emitted == stored + dropped' always holds)"),
+    "log_file_max_bytes": (int, 8 * 1024**2, "size cap per structured log file (head.log / node-<id>.log / worker-<id>.log) before rotation to .1..N; the raw worker .out/.err streams are capped only by worker lifetime"),
+    "log_file_backups": (int, 1, "rotated generations kept per structured log file (file.1 .. file.N; oldest deleted on rotation)"),
+    "log_death_tail_lines": (int, 20, "stderr + structured-log tail lines the node daemon attaches to a worker_death journal record (crash forensics: 'events --frames' shows the dying words next to the exit cause); 0 disables the capture"),
+    "log_error_storm_threshold": (int, 50, "error records within log_error_storm_window_s that raise ONE log_error_storm cluster-journal event per excursion (re-armed when the rate halves); 0 disables storm detection"),
+    "log_error_storm_window_s": (float, 10.0, "sliding window for error-storm rate detection"),
     "timeseries_ring_points": (int, 512, "points kept per (node, metric) hardware time series at the head"),
     "cluster_event_journal_size": (int, 4096, "structured cluster events (node/worker/actor/spill/lease/autoscaler transitions) kept in the head's journal ring ('python -m ray_tpu events'); oldest evict first"),
 }

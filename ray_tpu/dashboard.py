@@ -28,6 +28,11 @@ Endpoints:
   GET /api/events?after_seq=N&type=T&limit=K
                    cluster event journal (node/worker/actor lifecycle,
                    spill overflow, lease failures, autoscaler decisions)
+  GET /api/logs?after_seq=N&role=R&node=N&worker=W&level=L&since=T
+               &grep=RE&trace=TID&request=RID&limit=K
+                   cluster-wide structured log search over the head's
+                   LogStore (per-process severity rings fed by
+                   telemetry_push; util/log_plane.py)
   GET /api/timeline task spans (chrome-trace convertible)
   GET /api/jobs    submitted jobs
   GET /api/nodes   per-node agent stats (cpu/mem/disk/store/worker RSS —
@@ -160,6 +165,27 @@ class Dashboard:
                             "limit": int(q.get("limit", ["0"])[0] or 0),
                         }
                         data = client.call("events_dump", payload,
+                                           timeout=10)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
+                    if parsed.path == "/api/logs":
+                        q = parse_qs(parsed.query)
+                        payload = {
+                            "after_seq": int(
+                                q.get("after_seq", ["0"])[0] or 0),
+                            "role": q.get("role", [""])[0],
+                            "node": q.get("node", [""])[0],
+                            "worker": q.get("worker", [""])[0],
+                            "level": q.get("level", [""])[0],
+                            "since": float(
+                                q.get("since", ["0"])[0] or 0.0),
+                            "grep": q.get("grep", [""])[0],
+                            "trace": q.get("trace", [""])[0],
+                            "request": q.get("request", [""])[0],
+                            "limit": int(q.get("limit", ["0"])[0] or 0),
+                        }
+                        data = client.call("logs_dump", payload,
                                            timeout=10)
                         self._send(200, json.dumps(
                             data, default=str).encode(), "application/json")

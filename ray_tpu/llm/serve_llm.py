@@ -24,7 +24,7 @@ from typing import Any, Dict, Iterator, List, Optional
 from ray_tpu.llm.engine import InferenceEngine
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models.llama import LlamaConfig
-from ray_tpu.util import trace_context
+from ray_tpu.util import log_plane, trace_context
 
 
 def _ambient_trace_id() -> str:
@@ -95,22 +95,32 @@ class LLMServer:
         ev = threading.Event()
         rid = self.engine.add_request(prompt, max_tokens,
                                       trace_id=_ambient_trace_id())
-        with self._lock:
-            self._events[rid] = ev
-            if rid in self._results:  # engine already finished it
-                ev.set()
-        self._wake.set()
-        if not ev.wait(timeout=300):
-            # the engine will still finish the request eventually; mark it
-            # abandoned so _loop drops the late result instead of leaking
-            # it (and the event) forever
+        # ambient request id: every log record emitted while this
+        # request is in flight on this thread carries request_id=rid,
+        # so `ray_tpu logs --request RID` finds it
+        with log_plane.request_context(rid):
+            log_plane.get_logger().info(
+                f"llm request start ({len(prompt)} prompt tok, "
+                f"max_new {max_tokens})")
             with self._lock:
+                self._events[rid] = ev
+                if rid in self._results:  # engine already finished it
+                    ev.set()
+            self._wake.set()
+            if not ev.wait(timeout=300):
+                # the engine will still finish the request eventually;
+                # mark it abandoned so _loop drops the late result
+                # instead of leaking it (and the event) forever
+                with self._lock:
+                    self._events.pop(rid, None)
+                    self._abandoned.add(rid)
+                log_plane.get_logger().warning("llm request timed out")
+                raise TimeoutError(f"LLM request {rid} timed out")
+            with self._lock:
+                toks = self._results.pop(rid)
                 self._events.pop(rid, None)
-                self._abandoned.add(rid)
-            raise TimeoutError(f"LLM request {rid} timed out")
-        with self._lock:
-            toks = self._results.pop(rid)
-            self._events.pop(rid, None)
+            log_plane.get_logger().info(
+                f"llm request finished ({len(toks)} tok)")
         return {"token_ids": toks, "request_id": rid}
 
     # ------------------------------------------------------------ streaming
@@ -125,6 +135,13 @@ class LLMServer:
             rid = self.engine.add_request(prompt, max_tokens,
                                           trace_id=_ambient_trace_id())
             self._token_qs[rid] = q
+        # a generator can't hold the ambient contextvar across yields
+        # without leaking it into the consumer, so stamp the lifecycle
+        # records explicitly instead
+        with log_plane.request_context(rid):
+            log_plane.get_logger().info(
+                f"llm stream start ({len(prompt)} prompt tok, "
+                f"max_new {max_tokens})")
         self._wake.set()
         produced: List[int] = []
         completed = False
@@ -136,6 +153,9 @@ class LLMServer:
                     break
                 produced.extend(item)
                 yield {"token_ids": item, "request_id": rid}
+            with log_plane.request_context(rid):
+                log_plane.get_logger().info(
+                    f"llm stream finished ({len(produced)} tok)")
             yield {"done": True, "request_id": rid,
                    "token_ids": list(produced),
                    "finish_reason": self.engine.finish_reason(rid),

@@ -872,6 +872,21 @@ class ClusterBackend:
             stack_profiler.ensure_started()
         except Exception:  # noqa: BLE001 — profiling never stops connect
             pass
+        # structured log plane for DRIVERS (workers install theirs in
+        # worker_main with the node/worker identity the daemon passed;
+        # installing a generic one here first would shadow it)
+        if role == "driver":
+            try:
+                from ray_tpu.util import log_plane
+                wid12 = self.worker.worker_id.hex()[:12]
+                log_plane.ensure_started(
+                    role="driver",
+                    node=(self.local_node_id or "")[:12], worker=wid12,
+                    log_dir=log_plane.session_log_dir(
+                        os.environ.get("RTPU_SESSION", "")),
+                    filename=f"driver-{wid12}.log")
+            except Exception:  # noqa: BLE001 — never stops connect
+                pass
 
     def _defer_actor_flush(self, sub) -> None:
         if not self._native_transport:
@@ -953,8 +968,13 @@ class ClusterBackend:
             # profiling is disabled or nothing was sampled)
             from ray_tpu.util import stack_profiler
             profiles = stack_profiler.drain_export()
+            # this process's structured-log window + staged error-storm
+            # events (None/[] when the plane is off or nothing logged)
+            from ray_tpu.util import log_plane
+            logs = log_plane.drain_export()
+            journal = journal + log_plane.drain_journal_events()
             if snap or events or tracked or samples or llm_requests \
-                    or journal or profiles:
+                    or journal or profiles or logs:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
@@ -962,7 +982,7 @@ class ClusterBackend:
                     "metrics": snap, "events": events,
                     "objects": objects, "samples": samples,
                     "llm_requests": llm_requests, "journal": journal,
-                    "profiles": profiles})
+                    "profiles": profiles, "logs": logs})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 
@@ -1340,7 +1360,8 @@ class ClusterBackend:
         for stream, line in p.get("lines", ()):
             out = sys.stderr if stream == "stderr" else sys.stdout
             try:
-                print(f"{prefix} {line}", file=out, flush=True)
+                out.write(f"{prefix} {line}\n")
+                out.flush()
             except Exception:  # noqa: BLE001
                 break
         return True
@@ -1635,6 +1656,9 @@ def connect_or_start(worker, address: Optional[str] = None,
     owned: list = []
     if address is None:
         session = os.urandom(4).hex()
+        # the driver's own log plane (and any process it spawns) files
+        # under the same session log directory as the daemons
+        os.environ["RTPU_SESSION"] = session
         head_proc, address = start_head(session)
         owned.append(head_proc)
         merged = dict(resources or {})

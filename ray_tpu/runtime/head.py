@@ -340,6 +340,22 @@ class Head:
             profiler_mod.ensure_started()
         except Exception:  # noqa: BLE001 — profiling must never stop boot
             pass
+        # structured log plane (util/log_plane.py): every process's log
+        # ring rides telemetry_push into per-process rings here, served
+        # by logs_dump — and the head logs ITSELF (snapshot warnings,
+        # lifecycle diagnostics) into the same store + head.log
+        from ray_tpu.util import log_plane as log_plane_mod
+        self._log_plane_mod = log_plane_mod
+        self._logs = log_plane_mod.LogStore()
+        try:
+            log_plane_mod.ensure_started(
+                role="head",
+                log_dir=log_plane_mod.session_log_dir(session),
+                filename="head.log")
+            log_plane_mod.get_logger().info(
+                f"head started (session {session})")
+        except Exception:  # noqa: BLE001 — logging must never stop boot
+            pass
         # unserviceable demand, deduped per (requester, shape): each
         # submitter polls its shape every ~0.2s, so per-poll appends would
         # over-count 25x per window (the autoscaler's demand signal;
@@ -383,6 +399,7 @@ class Head:
             "events_dump": self._h_events_dump,
             "objects_dump": self._h_objects_dump,
             "profiles_dump": self._h_profiles_dump,
+            "logs_dump": self._h_logs_dump,
             "profiles_record": self._h_profiles_record,
             "journal_record": self._h_journal_record,
             "autoscaler_state": self._h_autoscaler_state,
@@ -461,9 +478,10 @@ class Head:
             with open(self._persist_path, "rb") as f:
                 data = pickle.load(f)
         except Exception as e:  # noqa: BLE001 — unreadable/torn snapshot
-            print(f"WARNING: discarding unreadable head snapshot "
-                  f"{self._persist_path}: {e!r}", file=sys.stderr,
-                  flush=True)
+            from ray_tpu.util import log_plane
+            log_plane.get_logger().warning(
+                f"discarding unreadable head snapshot "
+                f"{self._persist_path}: {e!r}")
             return
         with self._lock:
             for k, raw in data.get("kv_raw", {}).items():
@@ -1402,10 +1420,18 @@ class Head:
         trace_id = ctx_t[0] if ctx_t else new_trace_id()
         reason = p.get("reason", "worker died")
         wid = p.get("worker_id") or b""
+        # crash forensics: the node daemon tails the dead worker's stderr
+        # file + structured log file and sends the dying words along —
+        # bounded here again so a hostile report can't bloat the journal
+        tails = {}
+        for k in ("stderr_tail", "log_tail"):
+            v = p.get(k)
+            if v:
+                tails[k] = [str(ln)[:500] for ln in list(v)[-50:]]
         self.journal.record(
             "worker_death", trace_id=trace_id,
             worker_id=wid.hex() if isinstance(wid, bytes) else str(wid),
-            node_id=p.get("node_id", ""), exit_cause=reason)
+            node_id=p.get("node_id", ""), exit_cause=reason, **tails)
         self._on_actor_worker_lost(
             None, reason, worker_id=p["worker_id"], trace_id=trace_id)
         return True
@@ -1674,6 +1700,13 @@ class Head:
             self._profiles.ingest(
                 p["worker"], p["profiles"], role=p.get("role", ""),
                 node=(p.get("node") or "")[:12], worker=p["worker"][:12])
+        if p.get("logs"):
+            # structured log windows -> per-process severity rings (own
+            # lock, outside _lock; seq assigned at arrival is the
+            # logs_dump follow cursor)
+            self._logs.ingest(
+                p["worker"], p["logs"], role=p.get("role", ""),
+                node=(p.get("node") or "")[:12], worker=p["worker"][:12])
         for ev in p.get("journal", ()):
             # worker-originated cluster events (spill overflows): the
             # journal assigns seq/ts at arrival so ordering is the head's
@@ -1730,6 +1763,33 @@ class Head:
         return self._profiles.dump(
             role=p.get("role", ""), node=p.get("node", ""),
             worker=p.get("worker", ""), top=int(p.get("top", 0) or 0))
+
+    def _h_logs_dump(self, p, ctx):
+        """Merged structured log records from the LogStore (filters:
+        role/node/worker substring, severity floor, since-ts, msg regex,
+        trace/request-id substring; after_seq cursor for --follow —
+        same shape as events_dump)."""
+        p = p or {}
+        try:
+            # the head drains its OWN ring (and staged storm events) at
+            # read time — unlike workers/nodes it has no telemetry
+            # flush to ride (same contract as _h_profiles_dump)
+            export = self._log_plane_mod.drain_export()
+            if export:
+                self._logs.ingest("head", export, role="head")
+            for ev in self._log_plane_mod.drain_journal_events():
+                etype = ev.pop("type", "") or "log_error_storm"
+                self.journal.record(etype, **ev)
+        except Exception:  # noqa: BLE001 — logging never fails a dump
+            pass
+        return self._logs.dump(
+            after_seq=int(p.get("after_seq", 0) or 0),
+            role=p.get("role", ""), node=p.get("node", ""),
+            worker=p.get("worker", ""), level=p.get("level", ""),
+            since=float(p.get("since", 0.0) or 0.0),
+            grep=p.get("grep", ""), trace=p.get("trace", ""),
+            request=p.get("request", ""),
+            limit=int(p.get("limit", 0) or 0))
 
     def _h_profiles_record(self, p, ctx):
         """On-demand burst capture fanned out cluster-wide ('profile
@@ -1966,7 +2026,8 @@ def main() -> None:
         stop.set()
 
     signal.signal(signal.SIGTERM, _term)
-    print(f"RTPU_HEAD_READY {head.address}", flush=True)
+    sys.stdout.write(f"RTPU_HEAD_READY {head.address}\n")
+    sys.stdout.flush()
     try:
         while not stop.wait(3600):
             pass

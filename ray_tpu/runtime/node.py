@@ -50,13 +50,19 @@ def _proc_dead(proc) -> bool:
 
 class _WorkerEntry:
     __slots__ = ("worker_id", "proc", "address", "ready", "state", "actor_id",
-                 "chips", "env_key", "idle_since", "cgroup_leaf")
+                 "chips", "env_key", "idle_since", "cgroup_leaf",
+                 "out_path", "err_path", "log_path")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
                  env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
         self.cgroup_leaf: Optional[str] = None
+        # durable per-worker stream/log files in the session log dir
+        # (None when the log plane is disabled: streams are inherited)
+        self.out_path: Optional[str] = None
+        self.err_path: Optional[str] = None
+        self.log_path: Optional[str] = None
         self.address: Optional[str] = None
         self.ready = threading.Event()
         self.state = "starting"  # starting | idle | leased | actor | dead
@@ -179,6 +185,23 @@ class NodeDaemon:
             stack_profiler.ensure_started()
         except Exception:  # noqa: BLE001 — profiling never stops boot
             pass
+        # structured log plane: the daemon's own diagnostics (OOM kills,
+        # spawn failures) go to node-<id>.log + the head's LogStore, and
+        # _log_dir is where spawned workers' .out/.err streams land —
+        # the durable half of crash forensics
+        self._log_dir: Optional[str] = None
+        try:
+            from ray_tpu.util import log_plane
+            if log_plane.ensure_started(
+                    role="node", node=self.node_id[:12],
+                    log_dir=log_plane.session_log_dir(session),
+                    filename=f"node-{self.node_id[:12]}.log") is not None:
+                self._log_dir = log_plane.session_log_dir(session)
+                os.makedirs(self._log_dir, exist_ok=True)
+                log_plane.get_logger().info(
+                    f"node daemon started (session {session})")
+        except Exception:  # noqa: BLE001 — logging never stops boot
+            pass
         for _ in range(cfg.worker_pool_prestart):
             self._spawn_worker()
 
@@ -291,15 +314,46 @@ class NodeDaemon:
                       num_cpus: float = 0.0) -> _WorkerEntry:
         worker_id = WorkerID.from_random().binary()
         from ray_tpu.runtime.spawn import child_env
-        extra = {"RTPU_SESSION": self.session}
+        extra = {"RTPU_SESSION": self.session,
+                 "RTPU_NODE_ID": getattr(self, "node_id", "")}
         if env_extra:
             extra.update(env_extra)
         env = child_env(extra)
         cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main",
                self.address, self.head_addr, self.shm_name,
                worker_id.hex(), config_mod.GlobalConfig.to_json()]
-        proc = subprocess.Popen(cmd, env=env, cwd=cwd)
+        # durable raw streams: with the log plane on, the worker's
+        # stdout/stderr land in worker-<id>.{out,err} so a SIGKILL'd
+        # worker's dying words survive for the death-report tail
+        # (reference: raylet redirects worker output into the session
+        # log dir); without it, streams inherit as before
+        out_path = err_path = log_path = None
+        out_f = err_f = None
+        log_dir = getattr(self, "_log_dir", None)
+        if log_dir:
+            wid12 = WorkerID(worker_id).hex()[:12]
+            out_path = os.path.join(log_dir, f"worker-{wid12}.out")
+            err_path = os.path.join(log_dir, f"worker-{wid12}.err")
+            log_path = os.path.join(log_dir, f"worker-{wid12}.log")
+            try:
+                out_f = open(out_path, "ab")
+                err_f = open(err_path, "ab")
+            except OSError:
+                out_f = err_f = None
+                out_path = err_path = log_path = None
+        try:
+            proc = subprocess.Popen(
+                cmd, env=env, cwd=cwd,
+                stdout=out_f if out_f is not None else None,
+                stderr=err_f if err_f is not None else None)
+        finally:
+            # child holds its own dups; parent copies must not leak
+            for f in (out_f, err_f):
+                if f is not None:
+                    f.close()
         entry = _WorkerEntry(worker_id, proc, env_key=env_key)
+        entry.out_path, entry.err_path = out_path, err_path
+        entry.log_path = log_path
         if self.cgroups is not None:
             # post-fork attach (reference: cgroup_setup.h AddProcessToCgroup)
             # num_cpus is the lease's CPU request: it becomes the leaf's
@@ -347,6 +401,25 @@ class NodeDaemon:
         report = {"worker_id": entry.worker_id, "node_id": self.node_id,
                   "reason": "oom-killed" if fate == "oom"
                             else f"exit code {rc}"}
+        # crash forensics: attach the dead worker's dying words — the
+        # tail of its raw stderr file plus the last structured-log lines
+        # (both durable on THIS node's disk, so a SIGKILL loses nothing
+        # the kernel already flushed) — for the worker_death journal
+        tail_n = config_mod.GlobalConfig.log_death_tail_lines
+        if tail_n > 0 and (entry.err_path or entry.log_path):
+            from ray_tpu.util import log_plane
+            stderr_tail = log_plane.tail_lines(entry.err_path, tail_n)
+            if stderr_tail:
+                report["stderr_tail"] = stderr_tail
+            log_tail = []
+            for raw in log_plane.tail_lines(entry.log_path, tail_n):
+                try:
+                    log_tail.append(
+                        log_plane.format_record(json.loads(raw)))
+                except (ValueError, TypeError):
+                    log_tail.append(raw)
+            if log_tail:
+                report["log_tail"] = log_tail
         try:
             self._clients.get(self.head_addr).call("worker_died", report)
         except RpcError:
@@ -395,8 +468,11 @@ class NodeDaemon:
 
     def _oom_kill(self, entry: "_WorkerEntry", why: str) -> None:
         self._record_fate(entry.worker_id, "oom")
-        print(f"MEMORY MONITOR: killing worker pid={entry.proc.pid} "
-              f"({why})", file=sys.stderr, flush=True)
+        from ray_tpu.util import log_plane
+        log_plane.get_logger().warning(
+            f"MEMORY MONITOR: killing worker pid={entry.proc.pid} "
+            f"({why})",
+            worker=WorkerID(entry.worker_id).hex()[:12])
         try:
             entry.proc.kill()
         except OSError:
@@ -470,7 +546,7 @@ class NodeDaemon:
         (util/timeseries.py). Loss-tolerant by design: a down head just
         drops samples until it returns."""
         from ray_tpu.runtime.hw_sampler import HardwareSampler
-        from ray_tpu.util import stack_profiler
+        from ray_tpu.util import log_plane, stack_profiler
         period = config_mod.GlobalConfig.hw_sampler_period_s
 
         def _worker_rows():
@@ -489,9 +565,12 @@ class NodeDaemon:
             try:
                 samples = sampler.sample()
                 # the daemon's own collapsed-stack window rides the same
-                # push (None when profiling is off or nothing sampled)
+                # push (None when profiling is off or nothing sampled),
+                # as do its structured-log window + staged storm events
                 profiles = stack_profiler.drain_export()
-                if samples or profiles:
+                logs = log_plane.drain_export()
+                journal = log_plane.drain_journal_events()
+                if samples or profiles or logs or journal:
                     # the metrics snapshot rides along so daemon-side
                     # counters (pull-out bytes, spill restores served)
                     # aggregate at the head like any worker's
@@ -500,6 +579,7 @@ class NodeDaemon:
                             "worker": f"node:{self.node_id[:12]}",
                             "node": self.node_id, "role": "node",
                             "samples": samples, "profiles": profiles,
+                            "logs": logs, "journal": journal,
                             "metrics": metrics_mod.snapshot()})
             except Exception:  # noqa: BLE001 — head down: keep sampling
                 pass
@@ -968,7 +1048,8 @@ def main() -> None:
         object_store_bytes=args.get("object_store_bytes"),
         node_id=args.get("node_id"))
     signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
-    print(f"RTPU_NODE_READY {daemon.address}", flush=True)
+    sys.stdout.write(f"RTPU_NODE_READY {daemon.address}\n")
+    sys.stdout.flush()
     try:
         while not daemon._stopped.wait(1.0):
             pass

@@ -106,12 +106,18 @@ class _LogShipper:
         with self._lock:
             batch, self._buf = list(self._buf), _collections.deque()
             dropped, self._dropped = self._dropped, 0
-        if not batch and not dropped:
+        if not batch:
+            if dropped:
+                # the buffer drained between overflow and flush: carry
+                # the count to the next non-empty flush so the "...N
+                # lines dropped" notice is never itself dropped
+                with self._lock:
+                    self._dropped += dropped
             return
         by_owner: Dict[bytes, list] = {}
         for owner, stream, text in batch:
             by_owner.setdefault(owner, []).append((stream, text))
-        if dropped and batch:
+        if dropped:
             by_owner.setdefault(batch[-1][0], []).append(
                 ("stderr", f"... {dropped} log lines dropped (buffer full)"))
         me = self.backend.worker.worker_id.hex()[:8]
@@ -126,24 +132,52 @@ class _LogShipper:
 
 
 class _TeeStream:
-    """File-like wrapper: writes through to the real stream AND ships
-    complete lines to the log shipper."""
+    """File-like wrapper: writes through to the real stream (which the
+    node daemon redirects into the durable worker-<id>.{out,err} files)
+    AND ships complete lines to the log shipper (owner push) and the
+    structured log plane (local file sink + head ring) — so output
+    produced before the first task, when the shipper has no owner yet,
+    is still captured instead of silently discarded."""
 
-    def __init__(self, real, name: str, shipper: _LogShipper):
+    def __init__(self, real, name: str,
+                 shipper: Optional[_LogShipper] = None):
         self._real = real
         self._name = name
         self._shipper = shipper
         self._partial = ""
+
+    def _emit(self, line: str) -> None:
+        if self._shipper is not None:
+            self._shipper.emit(self._name, line)
+        if not line:
+            return
+        try:
+            from ray_tpu.util import log_plane
+            logger = log_plane.get_global()
+            if logger is not None:
+                # stderr is error severity: the LogStore's severity-
+                # indexed rings keep it alive through debug floods, and
+                # tracebacks feed the error-fingerprint/storm machinery
+                logger.log("error" if self._name == "stderr" else "info",
+                           line, stream=self._name)
+        except Exception:  # noqa: BLE001 — log loss must never kill
+            pass
 
     def write(self, text) -> int:
         n = self._real.write(text)
         self._partial += str(text)
         while "\n" in self._partial:
             line, self._partial = self._partial.split("\n", 1)
-            self._shipper.emit(self._name, line)
+            self._emit(line)
         return n
 
     def flush(self) -> None:
+        # a trailing partial line (print(..., end='') then flush, or
+        # process exit) is emitted, not dropped: the last words before
+        # a crash are exactly the ones written without a newline
+        if self._partial:
+            line, self._partial = self._partial, ""
+            self._emit(line)
         self._real.flush()
 
     def __getattr__(self, attr):
@@ -812,11 +846,32 @@ def main() -> None:
     backend = ClusterBackend.connect_as_worker(
         global_worker, head_addr, shm_name, worker_id)
     executor = Executor(backend, global_worker)
+    # structured log plane: records go to worker-<id>.log (same dir the
+    # node daemon pointed our raw .out/.err streams at) and ride the
+    # backend's telemetry flush to the head's LogStore
+    from ray_tpu.util import log_plane
+    try:
+        log_plane.ensure_started(
+            role="worker",
+            node=os.environ.get("RTPU_NODE_ID", "")[:12],
+            worker=worker_hex[:12],
+            log_dir=log_plane.session_log_dir(
+                os.environ.get("RTPU_SESSION", "")),
+            filename=f"worker-{worker_hex[:12]}.log")
+    except Exception:  # noqa: BLE001 — logging must never stop boot
+        pass
+    shipper = None
     if config_mod.GlobalConfig.log_to_driver:
         shipper = _LogShipper(backend)
         executor.log_shipper = shipper
+    if shipper is not None or log_plane.get_global() is not None:
         sys.stdout = _TeeStream(sys.stdout, "stdout", shipper)
         sys.stderr = _TeeStream(sys.stderr, "stderr", shipper)
+        # emit trailing partial lines on orderly exit (SIGKILL loses
+        # them from the rings — the durable .out/.err still have them)
+        import atexit
+        atexit.register(sys.stderr.flush)
+        atexit.register(sys.stdout.flush)
     backend.server.handlers.update({
         "push_task": executor.handle_push_task,
         "push_task_batch": executor.handle_push_task_batch,

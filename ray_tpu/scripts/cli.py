@@ -562,6 +562,65 @@ def cmd_events(args) -> int:
     return 0
 
 
+def _logs_payload(args) -> dict:
+    return {
+        "role": "head" if getattr(args, "head", False) else "",
+        "node": args.node or "",
+        "worker": args.worker or "",
+        "level": args.level or "",
+        "since": float(args.since or 0.0),
+        "grep": args.grep or "",
+        "trace": args.trace or "",
+        "request": args.request or "",
+    }
+
+
+def cmd_logs(args) -> int:
+    """Search (or follow) the head's cluster-wide structured log store:
+    every process's recent records, severity-ring bounded, filterable by
+    node/worker/role/level/regex and correlated by trace or request id
+    (reference: `ray logs` over the per-session log directory; here the
+    records also ride telemetry_push into a head-side ring so the CLI
+    works without reaching into each node's filesystem)."""
+    from ray_tpu.util.log_plane import format_record
+    address = load_address(args.address)
+    client = _client(address)
+    if not args.follow:
+        payload = _logs_payload(args)
+        payload["limit"] = int(args.limit or 0)
+        data = client.call("logs_dump", payload, timeout=10)
+        if args.format == "json":
+            print(json.dumps(data, indent=2, default=str))
+            return 0
+        recs = data.get("records", [])
+        for rec in recs:
+            print(format_record(rec))
+        dropped = data.get("dropped_total", 0)
+        note = f", {dropped} dropped at sources" if dropped else ""
+        print(f"({len(recs)} record(s){note})", file=sys.stderr)
+        return 0
+    after = 0
+    frames = args.frames  # hidden test hook: bounded poll count
+    try:
+        while True:
+            payload = _logs_payload(args)
+            payload["after_seq"] = after
+            data = client.call("logs_dump", payload, timeout=10)
+            for rec in data.get("records", []):
+                print(format_record(rec))
+                after = max(after, int(rec.get("seq", 0)))
+            after = max(after, int(data.get("last_seq", 0)))
+            sys.stdout.flush()
+            if frames is not None:
+                frames -= 1
+                if frames <= 0:
+                    break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _fmt_ms(v) -> str:
     return f"{v * 1e3:.1f}ms" if v is not None else "-"
 
@@ -706,9 +765,25 @@ def cmd_trace(args) -> int:
         rec = recs[0]
         tid = rec.get("trace_id") or args.trace_id
         roots = assemble_trace(events, trace_id=tid) if tid else []
+        # log lines stamped with this request id (or its trace id) from
+        # the head's structured log store, interleaved under the render
+        logs = []
+        try:
+            data = client.call("logs_dump", {"request": args.request},
+                               timeout=10)
+            logs = data.get("records", [])
+            if tid:
+                data = client.call("logs_dump", {"trace": tid},
+                                   timeout=10)
+                have = {(r.get("seq"), r.get("pid")) for r in logs}
+                logs += [r for r in data.get("records", [])
+                         if (r.get("seq"), r.get("pid")) not in have]
+            logs.sort(key=lambda r: r.get("ts", 0))
+        except Exception:
+            logs = []
         if args.format == "json":
-            print(json.dumps({"record": rec, "spans": roots},
-                             indent=2, default=str))
+            print(json.dumps({"record": rec, "spans": roots,
+                              "logs": logs}, indent=2, default=str))
             return 0
         print(f"request {rec['rid']}  trace {tid or '-'}")
         for r in roots:
@@ -717,6 +792,11 @@ def cmd_trace(args) -> int:
             print("  (no spans for this trace yet — the router's "
                   "telemetry flush may still be pending)")
         print(format_request_timeline(rec, indent="  "))
+        if logs:
+            from ray_tpu.util.log_plane import format_record
+            print(f"  logs ({len(logs)} correlated line(s)):")
+            for lrec in logs:
+                print(f"    {format_record(lrec)}")
         return 0
     if getattr(args, "train_step", False):
         step = latest_train_step(events)
@@ -888,6 +968,39 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)  # test hook: bounded polls
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("logs",
+                        help="search the cluster-wide structured log "
+                             "store (per-process rings at the head): "
+                             "filter by node/worker/level/regex, "
+                             "correlate by --trace / --request, or "
+                             "--follow live")
+    sp.add_argument("--address")
+    sp.add_argument("--follow", action="store_true",
+                    help="poll for new records until ctrl-c")
+    sp.add_argument("--grep", default="",
+                    help="only records whose message matches this regex")
+    sp.add_argument("--level", default="",
+                    help="severity floor (debug/info/warning/error)")
+    sp.add_argument("--node", default="",
+                    help="only processes on this node id (prefix match)")
+    sp.add_argument("--worker", default="",
+                    help="only this worker id (prefix match)")
+    sp.add_argument("--head", action="store_true",
+                    help="only the head process")
+    sp.add_argument("--trace", default="",
+                    help="only records stamped with this trace id")
+    sp.add_argument("--request", default="",
+                    help="only records stamped with this LLM request id")
+    sp.add_argument("--since", type=float, default=0.0,
+                    help="only records newer than this unix timestamp")
+    sp.add_argument("--limit", type=int, default=0,
+                    help="newest N records only")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--frames", type=int, default=None,
+                    help=argparse.SUPPRESS)  # test hook: bounded polls
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("timeline", help="export task timeline "
                                          "(chrome trace)")

@@ -301,6 +301,34 @@ def profile_dropped_samples_total_counter() -> Counter:
                                "overflow")
 
 
+def log_records_total_counter() -> Counter:
+    """Structured log records emitted by this process's log plane
+    (util/log_plane.py), by severity — the denominator the drop counter
+    is measured against."""
+    return Counter("log_records_total",
+                   description="structured log records emitted",
+                   tag_keys=("level",))
+
+
+def log_dropped_records_total_counter() -> Counter:
+    """Records dropped on ring overflow (log_ring_records) before the
+    telemetry flush shipped them. The file sink still has them; only the
+    head-side queryable ring under-reports — and by exactly this much
+    (emitted == stored + dropped)."""
+    return Counter("log_dropped_records_total",
+                   description="log records dropped on ring overflow")
+
+
+def log_errors_total_counter() -> Counter:
+    """Error-severity records by message fingerprint (digits/ids
+    normalized out, so one bug is one fingerprint across a thousand
+    instances; the per-process tag space is capped, long tail folds into
+    'other')."""
+    return Counter("log_errors_total",
+                   description="error log records by message fingerprint",
+                   tag_keys=("fingerprint",))
+
+
 def train_checkpoint_write_seconds_histogram() -> Histogram:
     """Wall seconds of one host's checkpoint shard write (serialize +
     upload, measured on the background writer thread — the time the

@@ -430,6 +430,31 @@ def test_profile_and_skew_metric_names_follow_convention():
     assert tuple(skew.tag_keys) == ("phase", "host")
 
 
+def test_log_metric_names_follow_convention():
+    """Same lint for the log-plane series: log_* counters carry a
+    sanctioned unit suffix, and the tagged ones declare exactly the tag
+    keys the docs promise (level for volume, fingerprint for the error
+    dedup series) so Prometheus renders stay stable."""
+    import re
+
+    from ray_tpu.util import metrics as m
+
+    pat = re.compile(
+        r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)*_(bytes|seconds|total|count)$")
+    names = set()
+    for f in (m.log_records_total_counter,
+              m.log_dropped_records_total_counter,
+              m.log_errors_total_counter):
+        inst = f()
+        assert pat.match(inst.name), inst.name
+        assert inst.name.startswith("log_"), inst.name
+        names.add(inst.name)
+    assert len(names) == 3
+    assert tuple(m.log_records_total_counter().tag_keys) == ("level",)
+    assert tuple(m.log_errors_total_counter().tag_keys) == ("fingerprint",)
+    assert tuple(m.log_dropped_records_total_counter().tag_keys) == ()
+
+
 def test_task_event_buffer_ring_eviction():
     """Satellite: the span buffer is a ring — at MAX_BUFFER the OLDEST
     spans are evicted (not the newest refused) and the __dropped__
