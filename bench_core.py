@@ -420,6 +420,44 @@ def main() -> None:
         ray_tpu.kill(logs_on)
         ray_tpu.kill(logs_off)
 
+    # XLA compile-tracker overhead A/B (<2% acceptance): the SAME
+    # small-task batch with the tracker on (default: idle ring + a
+    # jax.monitoring hook that never fires for jax-free tasks) vs off
+    # via env override — same best-of-alternating protocol as the
+    # profiler/log-plane knobs above. This bounds the plane's cost on
+    # the scheduling fast path; the per-compile cost is irrelevant by
+    # comparison (compiles are seconds, records are microseconds)
+    if not pattern or pattern in "compile_tracker_overhead_ab":
+        ct_on = Actor.options(runtime_env={
+            "env_vars": {"RTPU_compile_tracker_enabled": "1"}}).remote()
+        ct_off = Actor.options(runtime_env={
+            "env_vars": {"RTPU_compile_tracker_enabled": "0"}}).remote()
+        ray_tpu.get([ct_on.small_value_batch.remote(4),
+                     ct_off.small_value_batch.remote(4)])
+        best_on = best_off = 0.0
+        for _ in range(max(4, REPS)):
+            best_on = max(best_on, _measure(
+                lambda: ray_tpu.get(
+                    ct_on.small_value_batch.remote(500)), 500))
+            best_off = max(best_off, _measure(
+                lambda: ray_tpu.get(
+                    ct_off.small_value_batch.remote(500)), 500))
+        ratio = round(best_on / best_off, 4) if best_off else None
+        PROFILE_RESULTS["compile_tracker_overhead_ab"] = {
+            "on_ops_s": round(best_on, 2),
+            "off_ops_s": round(best_off, 2),
+            "on_vs_off": ratio,
+            "overhead_pct": round((1.0 - ratio) * 100.0, 2)
+            if ratio else None,
+            "protocol": "best-of-alternating 1-submitter/500-task "
+                        "windows, compile tracker on vs "
+                        "RTPU_compile_tracker_enabled=0"}
+        print(json.dumps({"metric": "compile_tracker_overhead_ab",
+                          **PROFILE_RESULTS["compile_tracker_overhead_ab"]}),
+              flush=True)
+        ray_tpu.kill(ct_on)
+        ray_tpu.kill(ct_off)
+
     timeit("single_client_tasks_sync",
            lambda: ray_tpu.get(small_value.remote()))
 

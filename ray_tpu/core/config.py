@@ -106,6 +106,10 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "log_death_tail_lines": (int, 20, "stderr + structured-log tail lines the node daemon attaches to a worker_death journal record (crash forensics: 'events --frames' shows the dying words next to the exit cause); 0 disables the capture"),
     "log_error_storm_threshold": (int, 50, "error records within log_error_storm_window_s that raise ONE log_error_storm cluster-journal event per excursion (re-armed when the rate halves); 0 disables storm detection"),
     "log_error_storm_window_s": (float, 10.0, "sliding window for error-storm rate detection"),
+    "compile_tracker_enabled": (bool, True, "XLA compile/dispatch tracker (util/compile_tracker.py) in every jax-bearing process: jax.monitoring listeners plus the jit cache-miss wrap seam record each compile (callable, module fingerprint, arg shape/dtype signature, duration, backend, trace id) into a bounded ring riding telemetry_push into the head's CompileStore ('python -m ray_tpu compiles'); disable to A/B the tracking overhead (BENCH_profile.json records it at <2%)"),
+    "compile_ring_records": (int, 512, "compile records buffered per process between telemetry flushes; overflow drops the OLDEST and counts it exactly, so the export ledger 'emitted == exported + stored + dropped' always holds and the head's dropped_total is an honest under-report bound"),
+    "compile_storm_threshold": (int, 8, "recompiles (same callable, NEW arg signature) within compile_storm_window_s that raise ONE compile_storm cluster-journal event per excursion (re-armed when the rate falls below half); the dominant TPU unexplained-latency failure is a silent recompile storm from unstable shapes — this makes it a cluster event with the offending callable and signature diff attached; 0 disables detection"),
+    "compile_storm_window_s": (float, 60.0, "sliding window for recompile-storm rate detection; size it to a few training steps / serving windows so one legitimate warmup sweep (N distinct shapes compiled once) ages out instead of re-firing"),
     "timeseries_ring_points": (int, 512, "points kept per (node, metric) hardware time series at the head"),
     "cluster_event_journal_size": (int, 4096, "structured cluster events (node/worker/actor/spill/lease/autoscaler transitions) kept in the head's journal ring ('python -m ray_tpu events'); oldest evict first"),
 }

@@ -33,6 +33,12 @@ Endpoints:
                    cluster-wide structured log search over the head's
                    LogStore (per-process severity rings fed by
                    telemetry_push; util/log_plane.py)
+  GET /api/compiles?after_seq=N&role=R&node=N&worker=W&callable=C
+                   &recompiles_only=1&by_callable=1&limit=K
+                   XLA compile records aggregated at the head
+                   (per-process rings fed by telemetry_push;
+                   util/compile_tracker.py — recompiles carry the arg
+                   signature diff that caused them)
   GET /api/timeline task spans (chrome-trace convertible)
   GET /api/jobs    submitted jobs
   GET /api/nodes   per-node agent stats (cpu/mem/disk/store/worker RSS —
@@ -186,6 +192,27 @@ class Dashboard:
                             "limit": int(q.get("limit", ["0"])[0] or 0),
                         }
                         data = client.call("logs_dump", payload,
+                                           timeout=10)
+                        self._send(200, json.dumps(
+                            data, default=str).encode(), "application/json")
+                        return
+                    if parsed.path == "/api/compiles":
+                        q = parse_qs(parsed.query)
+                        payload = {
+                            "after_seq": int(
+                                q.get("after_seq", ["0"])[0] or 0),
+                            "role": q.get("role", [""])[0],
+                            "node": q.get("node", [""])[0],
+                            "worker": q.get("worker", [""])[0],
+                            "callable": q.get("callable", [""])[0],
+                            "recompiles_only": bool(int(
+                                q.get("recompiles_only", ["0"])[0]
+                                or 0)),
+                            "by_callable": bool(int(
+                                q.get("by_callable", ["0"])[0] or 0)),
+                            "limit": int(q.get("limit", ["0"])[0] or 0),
+                        }
+                        data = client.call("compiles_dump", payload,
                                            timeout=10)
                         self._send(200, json.dumps(
                             data, default=str).encode(), "application/json")

@@ -176,6 +176,26 @@ class InferenceEngine:
         else:
             self._fns = _SingleChipFns(cfg, self.decode_chunk,
                                        self.prefill_chunk, max_batch)
+        # XLA compile tracker seam (util/compile_tracker.py): the three
+        # step entry points are wrapped so every compile is recorded
+        # with its arg signature — ground truth the O(1)-compile
+        # invariant below is cross-checked against in production, not
+        # just asserted in tests. The probe is compiled_step_programs
+        # itself: any growth across a single wrapped call belongs to
+        # that call.
+        from ray_tpu.util import compile_tracker
+        self._tracker = compile_tracker.ensure_started()
+        self._invariant_breached = False
+        if self._tracker is not None:
+            probe = self._fns.compiled_step_programs
+            self._fns.ragged_step = self._tracker.wrap(
+                self._fns.ragged_step, name="llm.ragged_step",
+                probe=probe)
+            self._fns.decode_loop = self._tracker.wrap(
+                self._fns.decode_loop, name="llm.decode_loop",
+                probe=probe)
+            self._fns.copy_page = self._tracker.wrap(
+                self._fns.copy_page, name="llm.copy_page", probe=probe)
         self.allocator = PageAllocator(total_pages)
         use_prefix = GlobalConfig.llm_prefix_cache \
             if prefix_cache is None else prefix_cache
@@ -767,7 +787,26 @@ class InferenceEngine:
         # ragged-step visibility: resident compiled programs (O(1) by
         # design), device dispatches per scheduler step, and the padding
         # fraction of ragged token slots over the gauge window
-        self._g_programs.set(float(self.compiled_step_programs()))
+        programs = self.compiled_step_programs()
+        self._g_programs.set(float(programs))
+        # the >3-programs invariant was test-only until now: in
+        # production, cross-check against the compile tracker and raise
+        # ONE llm_compile_invariant_breach cluster-journal event per
+        # excursion, carrying the tracker's signature diff — the exact
+        # argument whose shape moved. Re-arms if the count ever drops
+        # (fresh process / cache clear).
+        if programs > 3:
+            if not self._invariant_breached and self._tracker is not None:
+                self._invariant_breached = True
+                culprit = self._tracker.last_recompile("llm.") or {}
+                self._tracker.stage_journal_event(
+                    "llm_compile_invariant_breach",
+                    programs=programs, budget=3,
+                    callable=culprit.get("name", ""),
+                    diff=culprit.get("diff", []),
+                    signature=culprit.get("signature", []))
+        else:
+            self._invariant_breached = False
         d_steps = s["steps"] - last["steps"]
         if d_steps > 0:
             disp = sum(s[k] - last[k] for k in

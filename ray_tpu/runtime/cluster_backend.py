@@ -887,6 +887,17 @@ class ClusterBackend:
                     filename=f"driver-{wid12}.log")
             except Exception:  # noqa: BLE001 — never stops connect
                 pass
+            # XLA compile tracker for DRIVERS (workers install theirs
+            # in worker_main, same shadowing argument as the log plane;
+            # jax listeners only hook if/when this process imports jax)
+            try:
+                from ray_tpu.util import compile_tracker
+                compile_tracker.ensure_started(
+                    role="driver",
+                    node=(self.local_node_id or "")[:12],
+                    worker=self.worker.worker_id.hex()[:12])
+            except Exception:  # noqa: BLE001 — never stops connect
+                pass
 
     def _defer_actor_flush(self, sub) -> None:
         if not self._native_transport:
@@ -973,8 +984,14 @@ class ClusterBackend:
             from ray_tpu.util import log_plane
             logs = log_plane.drain_export()
             journal = journal + log_plane.drain_journal_events()
+            # this process's XLA compile window + staged compile_storm /
+            # invariant-breach events (None/[] when the tracker is off
+            # or this process never compiled anything)
+            from ray_tpu.util import compile_tracker
+            compiles = compile_tracker.drain_export()
+            journal = journal + compile_tracker.drain_journal_events()
             if snap or events or tracked or samples or llm_requests \
-                    or journal or profiles or logs:
+                    or journal or profiles or logs or compiles:
                 self.head.oneway("telemetry_push", {
                     "worker": self.worker.worker_id.hex(),
                     "role": self.role,
@@ -982,7 +999,8 @@ class ClusterBackend:
                     "metrics": snap, "events": events,
                     "objects": objects, "samples": samples,
                     "llm_requests": llm_requests, "journal": journal,
-                    "profiles": profiles, "logs": logs})
+                    "profiles": profiles, "logs": logs,
+                    "compiles": compiles})
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass
 

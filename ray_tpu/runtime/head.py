@@ -356,6 +356,18 @@ class Head:
                 f"head started (session {session})")
         except Exception:  # noqa: BLE001 — logging must never stop boot
             pass
+        # XLA compile observability plane (util/compile_tracker.py):
+        # every jax-bearing process's compile-record ring rides
+        # telemetry_push into per-process rings here, served by
+        # compiles_dump. The head starts its own tracker for symmetry —
+        # it never imports jax, so the listeners never hook
+        from ray_tpu.util import compile_tracker as compile_mod
+        self._compile_mod = compile_mod
+        self._compiles = compile_mod.CompileStore()
+        try:
+            compile_mod.ensure_started(role="head")
+        except Exception:  # noqa: BLE001 — tracking must never stop boot
+            pass
         # unserviceable demand, deduped per (requester, shape): each
         # submitter polls its shape every ~0.2s, so per-poll appends would
         # over-count 25x per window (the autoscaler's demand signal;
@@ -400,6 +412,7 @@ class Head:
             "objects_dump": self._h_objects_dump,
             "profiles_dump": self._h_profiles_dump,
             "logs_dump": self._h_logs_dump,
+            "compiles_dump": self._h_compiles_dump,
             "profiles_record": self._h_profiles_record,
             "journal_record": self._h_journal_record,
             "autoscaler_state": self._h_autoscaler_state,
@@ -1707,6 +1720,13 @@ class Head:
             self._logs.ingest(
                 p["worker"], p["logs"], role=p.get("role", ""),
                 node=(p.get("node") or "")[:12], worker=p["worker"][:12])
+        if p.get("compiles"):
+            # XLA compile windows -> per-process rings (own lock,
+            # outside _lock; seq assigned at arrival is the
+            # compiles_dump follow cursor)
+            self._compiles.ingest(
+                p["worker"], p["compiles"], role=p.get("role", ""),
+                node=(p.get("node") or "")[:12], worker=p["worker"][:12])
         for ev in p.get("journal", ()):
             # worker-originated cluster events (spill overflows): the
             # journal assigns seq/ts at arrival so ordering is the head's
@@ -1790,6 +1810,35 @@ class Head:
             grep=p.get("grep", ""), trace=p.get("trace", ""),
             request=p.get("request", ""),
             limit=int(p.get("limit", 0) or 0))
+
+    def _h_compiles_dump(self, p, ctx):
+        """Merged XLA compile records from the CompileStore (filters:
+        role/node/worker/callable substring, recompiles-only;
+        after_seq cursor for --watch; optional per-callable
+        aggregation for --by-callable — same cursor contract as
+        logs_dump)."""
+        p = p or {}
+        try:
+            # the head drains its OWN tracker (and staged storm events)
+            # at read time — unlike workers/nodes it has no telemetry
+            # flush to ride (same contract as _h_logs_dump). Inert in
+            # practice: the head never imports jax.
+            export = self._compile_mod.drain_export()
+            if export:
+                self._compiles.ingest("head", export, role="head")
+            for ev in self._compile_mod.drain_journal_events():
+                etype = ev.pop("type", "") or "compile_storm"
+                self.journal.record(etype, **ev)
+        except Exception:  # noqa: BLE001 — tracking never fails a dump
+            pass
+        return self._compiles.dump(
+            after_seq=int(p.get("after_seq", 0) or 0),
+            role=p.get("role", ""), node=p.get("node", ""),
+            worker=p.get("worker", ""),
+            callable=p.get("callable", ""),
+            recompiles_only=bool(p.get("recompiles_only")),
+            limit=int(p.get("limit", 0) or 0),
+            by_callable=bool(p.get("by_callable")))
 
     def _h_profiles_record(self, p, ctx):
         """On-demand burst capture fanned out cluster-wide ('profile

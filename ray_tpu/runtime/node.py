@@ -546,8 +546,17 @@ class NodeDaemon:
         (util/timeseries.py). Loss-tolerant by design: a down head just
         drops samples until it returns."""
         from ray_tpu.runtime.hw_sampler import HardwareSampler
-        from ray_tpu.util import log_plane, stack_profiler
+        from ray_tpu.util import compile_tracker, log_plane, \
+            stack_profiler
         period = config_mod.GlobalConfig.hw_sampler_period_s
+        # the daemon itself never imports jax, so its tracker stays a
+        # silent no-op — starting it anyway keeps the plane contract
+        # uniform across processes (and live if that ever changes)
+        try:
+            compile_tracker.ensure_started(role="node",
+                                           node=self.node_id[:12])
+        except Exception:  # noqa: BLE001 — telemetry never stops boot
+            pass
 
         def _worker_rows():
             with self._lock:
@@ -570,7 +579,10 @@ class NodeDaemon:
                 profiles = stack_profiler.drain_export()
                 logs = log_plane.drain_export()
                 journal = log_plane.drain_journal_events()
-                if samples or profiles or logs or journal:
+                compiles = compile_tracker.drain_export()
+                journal = journal + \
+                    compile_tracker.drain_journal_events()
+                if samples or profiles or logs or journal or compiles:
                     # the metrics snapshot rides along so daemon-side
                     # counters (pull-out bytes, spill restores served)
                     # aggregate at the head like any worker's
@@ -580,6 +592,7 @@ class NodeDaemon:
                             "node": self.node_id, "role": "node",
                             "samples": samples, "profiles": profiles,
                             "logs": logs, "journal": journal,
+                            "compiles": compiles,
                             "metrics": metrics_mod.snapshot()})
             except Exception:  # noqa: BLE001 — head down: keep sampling
                 pass

@@ -860,6 +860,18 @@ def main() -> None:
             filename=f"worker-{worker_hex[:12]}.log")
     except Exception:  # noqa: BLE001 — logging must never stop boot
         pass
+    # XLA compile tracker: jax-free at this point (the seam only hooks
+    # jax.monitoring once user code actually imports jax — re-checked
+    # at every telemetry flush), so workers that never touch jax pay
+    # one idle object
+    try:
+        from ray_tpu.util import compile_tracker
+        compile_tracker.ensure_started(
+            role="worker",
+            node=os.environ.get("RTPU_NODE_ID", "")[:12],
+            worker=worker_hex[:12])
+    except Exception:  # noqa: BLE001 — tracking must never stop boot
+        pass
     shipper = None
     if config_mod.GlobalConfig.log_to_driver:
         shipper = _LogShipper(backend)

@@ -621,6 +621,111 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def _compiles_payload(args) -> dict:
+    return {
+        "role": "",
+        "node": args.node or "",
+        "worker": args.worker or "",
+        "callable": args.callable or "",
+        "recompiles_only": bool(args.recompiles),
+        "by_callable": bool(args.by_callable),
+        "limit": int(args.limit or 0),
+    }
+
+
+def _fmt_compile_record(rec: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(rec.get("ts", 0)))
+    dur = rec.get("measured_s") or rec.get("duration_s") or 0.0
+    name = rec.get("name") or "<unattributed>"
+    mark = "RECOMPILE " if rec.get("recompile") else ""
+    sig = rec.get("signature") or []
+    sig_s = ", ".join(sig[:6]) + (", ..." if len(sig) > 6 else "")
+    line = (f"{ts}  {rec.get('role', '?'):<7}"
+            f"{(rec.get('worker') or '')[:12]:<13}"
+            f"{mark}{name}  [{rec.get('kind', '?')}] {dur * 1e3:.1f}ms"
+            f"  ({sig_s})")
+    for d in rec.get("diff") or []:
+        line += f"\n           diff {d}"
+    return line
+
+
+def _render_compiles(client, args) -> str:
+    data = client.call("compiles_dump", _compiles_payload(args),
+                       timeout=10)
+    if args.format == "json":
+        return json.dumps(data, indent=2, default=str)
+    lines = []
+    if args.by_callable:
+        agg = data.get("by_callable") or {}
+        if not agg:
+            return ("no compile records at the head (jax-bearing "
+                    "processes flush every metrics_export_period_s; is "
+                    "compile_tracker_enabled on?)")
+        lines.append(f"{'callable':<28} {'compiles':>8} {'recompiles':>10}"
+                     f" {'seconds':>9} {'procs':>6}  last signature")
+        rows = sorted(agg.items(),
+                      key=lambda kv: (-kv[1]["recompiles"],
+                                      -kv[1]["seconds"]))
+        for name, a in rows:
+            sig = a.get("last_sig") or []
+            sig_s = ", ".join(sig[:4]) + (", ..." if len(sig) > 4 else "")
+            lines.append(f"{name:<28} {a['compiles']:>8}"
+                         f" {a['recompiles']:>10} {a['seconds']:>9.3f}"
+                         f" {a['procs']:>6}  ({sig_s})")
+            for d in a.get("last_diff") or []:
+                lines.append(f"{'':<28} diff {d}")
+    else:
+        recs = data.get("records", [])
+        if not recs:
+            return ("no compile records at the head (jax-bearing "
+                    "processes flush every metrics_export_period_s; is "
+                    "compile_tracker_enabled on?)")
+        for rec in recs:
+            lines.append(_fmt_compile_record(rec))
+    dropped = data.get("dropped_total", 0)
+    note = f", {dropped} dropped" if dropped else ""
+    lines.append(f"({data.get('procs', 0)} process(es){note})")
+    return "\n".join(lines)
+
+
+def cmd_compiles(args) -> int:
+    """XLA compile records aggregated at the head (per-process rings
+    fed by telemetry_push; util/compile_tracker.py): every compile with
+    its callable, arg shape/dtype signature and duration — recompiles
+    flagged with the exact signature diff that caused them. --storms
+    lists the journal's once-per-excursion compile_storm events."""
+    address = load_address(args.address)
+    client = _client(address)
+    if args.storms:
+        evs = client.call("events_dump",
+                          {"type": "compile_storm",
+                           "limit": int(args.limit or 0)}, timeout=10)
+        if args.format == "json":
+            print(json.dumps(evs, indent=2, default=str))
+            return 0
+        for ev in evs:
+            print(_fmt_event(ev))
+        print(f"({len(evs)} storm(s))", file=sys.stderr)
+        return 0
+    if not args.watch:
+        print(_render_compiles(client, args))
+        return 0
+    frames = args.frames  # hidden test hook: bounded repaint count
+    try:
+        while True:
+            frame = _render_compiles(client, args)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            if frames is not None:
+                frames -= 1
+                if frames <= 0:
+                    break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _fmt_ms(v) -> str:
     return f"{v * 1e3:.1f}ms" if v is not None else "-"
 
@@ -751,6 +856,38 @@ def cmd_trace(args) -> int:
     address = load_address(args.address)
     client = _client(address)
     events = client.call("timeline_dump")
+    if getattr(args, "perfetto", ""):
+        # multi-plane export: task spans + train phases + LLM request
+        # timelines + XLA compile events + journal markers as named
+        # lanes on one wall clock (runtime/events.to_perfetto)
+        from ray_tpu.runtime.events import to_perfetto
+        compiles = []
+        requests = []
+        journal = []
+        try:
+            compiles = client.call("compiles_dump", {},
+                                   timeout=10).get("records", [])
+        except Exception:  # noqa: BLE001 — lane degrades to empty
+            pass
+        try:
+            requests = client.call("requests_dump", {}, timeout=10) or []
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            journal = client.call("events_dump", {}, timeout=10) or []
+        except Exception:  # noqa: BLE001
+            pass
+        trace = to_perfetto(events, compiles=compiles,
+                            requests=requests, journal=journal)
+        with open(args.perfetto, "w") as f:
+            json.dump(trace, f)
+        n = len(trace["traceEvents"])
+        lanes = sum(1 for e in trace["traceEvents"]
+                    if e.get("ph") == "M"
+                    and e.get("name") == "process_name")
+        print(f"wrote {n} events across {lanes} lanes to "
+              f"{args.perfetto} (load in ui.perfetto.dev)")
+        return 0
     if getattr(args, "request", ""):
         # merged view for one LLM request: the router/replica span tree
         # (via the trace_id the record carries) + the engine's
@@ -1002,6 +1139,39 @@ def main(argv=None) -> int:
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_logs)
 
+    sp = sub.add_parser("compiles",
+                        help="XLA compile records aggregated at the "
+                             "head: callable, arg signature, duration; "
+                             "recompiles carry the signature diff that "
+                             "caused them (util/compile_tracker.py)")
+    sp.add_argument("--address")
+    sp.add_argument("--node", default="",
+                    help="only processes on this node id (prefix match)")
+    sp.add_argument("--worker", default="",
+                    help="only this worker id (prefix match)")
+    sp.add_argument("--callable", default="",
+                    help="only compiles of callables matching this "
+                         "substring (e.g. llm. or train.)")
+    sp.add_argument("--recompiles", action="store_true",
+                    help="only recompiles (same callable, new arg "
+                         "signature — each carries its diff)")
+    sp.add_argument("--by-callable", action="store_true",
+                    dest="by_callable",
+                    help="aggregate per callable: compiles, recompiles, "
+                         "total seconds, processes")
+    sp.add_argument("--storms", action="store_true",
+                    help="list compile_storm journal events (one per "
+                         "recompile-rate excursion)")
+    sp.add_argument("--watch", action="store_true",
+                    help="repaint continuously until ctrl-c")
+    sp.add_argument("--limit", type=int, default=0,
+                    help="newest N records only")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--frames", type=int, default=None,
+                    help=argparse.SUPPRESS)  # test hook: bounded repaints
+    sp.add_argument("--format", choices=["plain", "json"], default="plain")
+    sp.set_defaults(fn=cmd_compiles)
+
     sp = sub.add_parser("timeline", help="export task timeline "
                                          "(chrome trace)")
     sp.add_argument("--address")
@@ -1021,6 +1191,11 @@ def main(argv=None) -> int:
                     help="merged timeline for one LLM request id: router/"
                          "replica spans + the engine's flight-recorder "
                          "lifecycle events")
+    sp.add_argument("--perfetto", default="", metavar="OUT",
+                    help="write a unified multi-plane Perfetto trace to "
+                         "OUT: task spans, train phases, LLM request "
+                         "timelines, XLA compiles and journal markers "
+                         "as named lanes on one clock")
     sp.add_argument("--format", choices=["plain", "json"], default="plain")
     sp.set_defaults(fn=cmd_trace)
 

@@ -17,8 +17,13 @@ FASTER than the sum (XLA overlapped work across phase boundaries), the
 compute phases are scaled proportionally so the breakdown always sums
 exactly to the measured step time — the invariant the smoke test pins.
 
-Compile time is reported separately (first fused call minus steady
-state) so warm-up can never leak into a steady-state MFU number.
+Compile time is reported separately so warm-up can never leak into a
+steady-state MFU number — MEASURED from the compile tracker's
+``jax.monitoring``-attributed phase durations when the tracker is live
+(util/compile_tracker.py wraps the fused step as its cache-miss seam),
+falling back to the old inference (first fused call minus steady
+state) when it is disabled; ``compile_source`` records which one the
+number is.
 
 Results ride the existing telemetry planes: phase gauges
 (util.metrics.train_phase_time_gauge) and a train_step span tree in the
@@ -44,6 +49,9 @@ class StepBreakdown:
     compile_time_s: float
     phases: Dict[str, float]
     n_steps: int = 1
+    # "measured" (compile tracker / jax.monitoring phase durations) or
+    # "inferred" (first fused call minus steady state)
+    compile_source: str = "inferred"
 
     def phase_ms(self) -> Dict[str, float]:
         return {k: v * 1e3 for k, v in self.phases.items()}
@@ -53,6 +61,7 @@ class StepBreakdown:
         `phases` sub-dict through train_phase_time_gauge)."""
         return {"step_time_s": self.step_time_s,
                 "compile_time_s": self.compile_time_s,
+                "compile_source": self.compile_source,
                 "phases": dict(self.phases)}
 
 
@@ -96,13 +105,34 @@ def profile_train_step(loss_fn: Callable[[Any, Any], jax.Array],
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    # MEASURED compile time: the tracker wraps the fused step (its jit
+    # cache-miss seam), so the first call below lands a compile record
+    # whose jax.monitoring-attributed phase seconds are the real number
+    # — the old first-call-minus-steady-state inference survives only
+    # as the fallback when the tracker is off or monitoring saw nothing
+    from ray_tpu.util import compile_tracker
+    tracker = compile_tracker.ensure_started()
+    timed_step = full_step
+    before_s = 0.0
+    if tracker is not None:
+        timed_step = tracker.wrap(full_step, name="train.full_step")
+        st = tracker.callable_stats("train.full_step")
+        before_s = st["measured_s"] if st else 0.0
+
     # compile + first-call timing for the fused program
     t0 = time.perf_counter()
-    jax.block_until_ready(full_step(params, opt_state, batch))
+    jax.block_until_ready(timed_step(params, opt_state, batch))
     first_call_s = time.perf_counter() - t0
-    step_s = _timed(full_step, params, opt_state, batch,
+    step_s = _timed(timed_step, params, opt_state, batch,
                     steps=steps, warmup=max(warmup - 1, 0))
     compile_s = max(first_call_s - step_s, 0.0)
+    compile_source = "inferred"
+    if tracker is not None:
+        st = tracker.callable_stats("train.full_step")
+        measured = (st["measured_s"] - before_s) if st else 0.0
+        if measured > 0:
+            compile_s = measured
+            compile_source = "measured"
 
     t_fwd = _timed(fwd, params, batch, steps=steps, warmup=warmup)
     t_fwdbwd = _timed(vag, params, batch, steps=steps, warmup=warmup)
@@ -125,7 +155,8 @@ def profile_train_step(loss_fn: Callable[[Any, Any], jax.Array],
                   "optimizer": t_opt * scale, "collective_wait": 0.0}
 
     breakdown = StepBreakdown(step_time_s=step_s, compile_time_s=compile_s,
-                              phases=phases, n_steps=steps)
+                              phases=phases, n_steps=steps,
+                              compile_source=compile_source)
     if emit:
         _emit_gauges(breakdown)
         _record_spans(breakdown)

@@ -329,6 +329,43 @@ def log_errors_total_counter() -> Counter:
                    tag_keys=("fingerprint",))
 
 
+def xla_compile_seconds_histogram() -> Histogram:
+    """Seconds spent in one XLA compile, as measured by the tracker
+    (util/compile_tracker.py): the summed /jax/core/compile/* phase
+    durations jax.monitoring attributed to the call when available,
+    else the wall time of the call that compiled. The distribution's
+    tail is the 'first step after a shape change' stall users feel."""
+    return Histogram(
+        "xla_compile_seconds",
+        description="seconds per XLA compile (monitoring-attributed "
+                    "phases, else compiling-call wall time)",
+        boundaries=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+                    120.0))
+
+
+def xla_compiles_total_counter() -> Counter:
+    """XLA compiles observed by this process's tracker, by process role
+    and kind — 'jit' for compiles caught at the wrap seam (named, with
+    signatures), monitoring phase names (backend_compile, jaxpr_trace,
+    jaxpr_to_mlir_module) for unattributed activity. A growing
+    backend_compile count with a flat jit count means compiles are
+    happening outside any wrapped callable — wrap it."""
+    return Counter("xla_compiles_total",
+                   description="XLA compiles by process role and kind",
+                   tag_keys=("process", "kind"))
+
+
+def xla_recompiles_total_counter() -> Counter:
+    """Compiles of a callable that ALREADY had a compiled signature —
+    i.e. cache misses caused by shape/dtype churn, the compiles the
+    ragged/padded designs exist to avoid. Non-zero in steady state is
+    the bug; the per-record signature diff in 'compiles' names the
+    argument that moved."""
+    return Counter("xla_recompiles_total",
+                   description="XLA recompiles (same callable, new arg "
+                               "signature)")
+
+
 def train_checkpoint_write_seconds_histogram() -> Histogram:
     """Wall seconds of one host's checkpoint shard write (serialize +
     upload, measured on the background writer thread — the time the

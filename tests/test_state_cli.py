@@ -530,3 +530,163 @@ def test_cli_status_and_list(state_rt):
     out = _cli("list", "objects", address=address)
     assert out.returncode == 0, out.stderr
     assert "capacity=" in out.stdout
+
+
+# ----------------------------------------------------------- compile plane
+
+
+def test_xla_metric_names_follow_convention():
+    """Same lint for the compile-plane series: xla_* metrics carry a
+    sanctioned unit suffix, the per-kind counter declares exactly the
+    (process, kind) tag keys the docs promise, and the recompile
+    counter + seconds histogram stay untagged so their cluster sums
+    read directly."""
+    import re
+
+    from ray_tpu.util import metrics as m
+
+    pat = re.compile(
+        r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)*_(bytes|seconds|total|count)$")
+    names = set()
+    for f in (m.xla_compile_seconds_histogram,
+              m.xla_compiles_total_counter,
+              m.xla_recompiles_total_counter):
+        inst = f()
+        assert pat.match(inst.name), inst.name
+        assert inst.name.startswith("xla_"), inst.name
+        names.add(inst.name)
+    assert len(names) == 3
+    assert tuple(m.xla_compiles_total_counter().tag_keys) == \
+        ("process", "kind")
+    assert tuple(m.xla_recompiles_total_counter().tag_keys) == ()
+    assert tuple(m.xla_compile_seconds_histogram().tag_keys) == ()
+
+
+def _seed_compile_records(probe):
+    """Push one compile window (built by the REAL tracker, so the wire
+    shape is authentic) + its staged storm event into the head. The
+    shape-unstable llm.ragged_step sequence yields 2 recompiles, which
+    crosses the threshold=2 storm knob exactly once."""
+    from ray_tpu.util.compile_tracker import CompileTracker
+
+    tr = CompileTracker(role="worker", node="clinode", worker="cliworker",
+                        ring_records=16, storm_threshold=2,
+                        storm_window_s=60.0)
+    tr.note_compile("llm.ragged_step", ["f32[8,128]", "i32[8]"],
+                    wall_s=0.5)
+    tr.note_compile("llm.ragged_step", ["f32[9,128]", "i32[8]"],
+                    wall_s=0.4)
+    tr.note_compile("llm.ragged_step", ["f32[10,128]", "i32[8]"],
+                    wall_s=0.3)
+    tr.note_compile("train.full_step", ["f32[16,64]"], wall_s=1.0)
+    probe.call("telemetry_push", {
+        "worker": "cliworker" + "0" * 23, "node": "clinode" + "0" * 25,
+        "role": "worker",
+        "compiles": tr.export(),
+        "journal": tr.drain_journal_events(),
+    }, timeout=10)
+
+
+def test_compiles_cli_smoke(state_rt):
+    """`compiles` renders the head's aggregated compile records with
+    recompiles flagged and their signature diff attached; --recompiles
+    filters, --by-callable aggregates, --storms lists the journal's
+    once-per-excursion events."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    address = global_worker.backend.head_addr
+    _seed_compile_records(global_worker.backend.head)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["compiles", "--address", address]) == 0
+    out = buf.getvalue()
+    assert "RECOMPILE llm.ragged_step" in out
+    assert "diff arg[0]: f32[8,128] -> f32[9,128]" in out
+    assert "train.full_step" in out and "cliworker" in out
+    assert "process(es)" in out
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["compiles", "--recompiles", "--format", "json",
+                         "--address", address]) == 0
+    data = json.loads(buf.getvalue())
+    recs = [r for r in data["records"]
+            if r["name"] == "llm.ragged_step"]
+    assert len(recs) >= 2
+    assert all(r["recompile"] for r in recs)
+    assert data["last_seq"] >= 4
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["compiles", "--by-callable",
+                         "--address", address]) == 0
+    out = buf.getvalue()
+    assert "callable" in out and "recompiles" in out
+    assert "llm.ragged_step" in out and "train.full_step" in out
+
+    # the threshold=2 excursion staged exactly one storm journal event
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["compiles", "--storms", "--format", "json",
+                         "--address", address]) == 0
+    storms = json.loads(buf.getvalue())
+    assert len(storms) == 1, storms
+    assert storms[0]["type"] == "compile_storm"
+    assert storms[0]["callable"] == "llm.ragged_step"
+
+
+def test_trace_perfetto_cli_smoke(state_rt, tmp_path):
+    """`trace --perfetto OUT` writes one multi-plane Chrome/Perfetto
+    trace: task-span lanes per node, the train step/phase lane, the XLA
+    compile lane (recompiles carrying their diff), and journal
+    instants — all on one wall clock."""
+    import io
+    import time as time_mod
+    from contextlib import redirect_stdout
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    address = global_worker.backend.head_addr
+    head = global_worker.backend.head
+    _seed_compile_records(head)
+    now = time_mod.time()
+    head.call("telemetry_push", {
+        "worker": "cliworker" + "0" * 23, "node": "clinode" + "0" * 25,
+        "events": [
+            {"name": "step", "kind": "train_step", "task_id": "tsp",
+             "start": now - 0.5, "end": now - 0.2, "ok": True},
+            {"name": "forward", "kind": "train_phase", "task_id": "tsp",
+             "start": now - 0.5, "end": now - 0.4, "ok": True},
+            {"name": "work_task", "kind": "task", "task_id": "t" * 32,
+             "start": now - 1.0, "end": now - 0.9, "ok": True},
+        ]}, timeout=10)
+
+    out_path = tmp_path / "cluster.perfetto.json"
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["trace", "--perfetto", str(out_path),
+                         "--address", address]) == 0
+    assert "lanes" in buf.getvalue()
+
+    with open(out_path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert trace.get("displayTimeUnit") == "ms"
+    lanes = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert "xla: compiles" in lanes, lanes
+    assert "train: steps + phases" in lanes, lanes
+    assert any(name.startswith("spans: node") for name in lanes), lanes
+    # the compile lane carries the recompile with its signature diff
+    rec = next(e for e in evs
+               if e.get("ph") == "X"
+               and str(e.get("name", "")).startswith("RECOMPILE"))
+    assert rec["args"]["diff"], rec
+    assert any(e.get("cat") == "train_phase" for e in evs)
+    assert any(e.get("cat") == "journal" for e in evs)
